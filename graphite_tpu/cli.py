@@ -1,0 +1,68 @@
+"""Command-line launcher.
+
+Plays the role of the reference's Makefile/tools launcher layer
+(reference: tools/, tests/Makefile.tests:44-78): compose a config from a
+file plus ``--section/key=value`` overrides and run a simulation.
+
+Usage:
+    graphite-tpu run [-c CONFIG] [--section/key=value ...] --trace TRACE.npz
+    graphite-tpu params [-c CONFIG] [--section/key=value ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import List, Optional
+
+from graphite_tpu.config import load_config, parse_overrides
+from graphite_tpu.params import SimParams
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="graphite-tpu")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run a simulation from a trace")
+    run.add_argument("-c", "--config", default=None)
+    run.add_argument("--trace", required=True, help="trace .npz path")
+    run.add_argument("-o", "--output", default=None, help="summary output path")
+
+    par = sub.add_parser("params", help="print derived simulation parameters")
+    par.add_argument("-c", "--config", default=None)
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    overrides, rest = parse_overrides(argv)
+    args = _build_parser().parse_args(rest)
+    cfg = load_config(args.config, overrides=overrides)
+
+    if args.command == "params":
+        params = SimParams.from_config(cfg)
+        print(json.dumps(dataclasses.asdict(params), indent=2, default=str))
+        return 0
+
+    if args.command == "run":
+        try:
+            from graphite_tpu.engine.driver import run_simulation_from_trace
+        except ImportError as e:  # engine lands in a later milestone of this build
+            raise SystemExit(f"simulation engine unavailable: {e}")
+
+        summary = run_simulation_from_trace(cfg, args.trace)
+        text = summary.render()
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+        else:
+            print(text)
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,413 @@
+"""Typed, derived simulation parameters — the model-factory boundary.
+
+``SimParams.from_config`` plays the role of the reference's config-selected
+model factories (CoreModel::create core_model.cc:15,
+MemoryManager::createMMU memory_manager.cc:29-52,
+NetworkModel::createModel network_model.h:90,
+QueueModel::create queue_model.h:7-39): every model variant is chosen here
+from the same config keys, and the chosen variants fully determine the
+shapes and constants of the jitted kernels.
+
+Everything in this tree is a hashable Python scalar/tuple, so a
+``SimParams`` can be a static argument to ``jax.jit`` — changing a model
+choice recompiles, changing runtime state does not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Optional, Tuple
+
+from graphite_tpu.config import Config, ConfigError
+from graphite_tpu.isa import STATIC_COST_TYPES, DVFSModule
+from graphite_tpu.time_base import ns_to_ps
+
+
+def _ceil_log2(x: int) -> int:
+    return max(0, (x - 1).bit_length())
+
+
+def _ceil_pow2(x: int) -> int:
+    return 1 << _ceil_log2(x)
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheParams:
+    """Geometry + latency for one set-associative cache level
+    (reference: common/tile/memory_subsystem/cache/cache.h:26-80 and the
+    [l1_icache/*]/[l1_dcache/*]/[l2_cache/*] sections)."""
+
+    name: str
+    line_size: int          # bytes
+    size_kb: int
+    associativity: int
+    num_banks: int
+    replacement: str        # 'lru' | 'round_robin'
+    data_access_cycles: int
+    tags_access_cycles: int
+    perf_model: str         # 'parallel' | 'sequential'
+    track_miss_types: bool
+
+    @property
+    def num_sets(self) -> int:
+        sets = (self.size_kb * 1024) // (self.line_size * self.associativity)
+        if sets * self.line_size * self.associativity != self.size_kb * 1024:
+            raise ConfigError(f"{self.name}: size not divisible into sets")
+        return sets
+
+    @property
+    def set_bits(self) -> int:
+        sets = self.num_sets
+        if sets & (sets - 1):
+            raise ConfigError(f"{self.name}: num_sets {sets} not a power of 2")
+        return sets.bit_length() - 1
+
+    @property
+    def access_cycles(self) -> int:
+        """Hit latency: parallel tag+data lookup takes max(), sequential
+        takes the sum (reference: cache_perf_model_parallel.h /
+        cache_perf_model_sequential.h)."""
+        if self.perf_model == "parallel":
+            return max(self.data_access_cycles, self.tags_access_cycles)
+        return self.data_access_cycles + self.tags_access_cycles
+
+    @classmethod
+    def from_config(cls, cfg: Config, section: str, name: str) -> "CacheParams":
+        g = lambda k: f"{section}/{k}"
+        return cls(
+            name=name,
+            line_size=cfg.get_int(g("cache_line_size")),
+            size_kb=cfg.get_int(g("cache_size")),
+            associativity=cfg.get_int(g("associativity")),
+            num_banks=cfg.get_int(g("num_banks")),
+            replacement=cfg.get_str(g("replacement_policy")),
+            data_access_cycles=cfg.get_int(g("data_access_time")),
+            tags_access_cycles=cfg.get_int(g("tags_access_time")),
+            perf_model=cfg.get_str(g("perf_model_type")),
+            track_miss_types=cfg.get_bool(g("track_miss_types")),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class DirectoryParams:
+    """DRAM-directory geometry (reference: [dram_directory] section;
+    auto-sizing semantics of
+    common/tile/memory_subsystem/cache/directory_cache.cc:243-330)."""
+
+    total_entries: int
+    associativity: int
+    max_hw_sharers: int
+    directory_type: str     # full_map | limited_broadcast | limited_no_broadcast | ackwise | limitless
+    access_cycles: int
+    limitless_trap_cycles: int
+
+    @property
+    def num_sets(self) -> int:
+        return self.total_entries // self.associativity
+
+    @classmethod
+    def from_config(cls, cfg: Config, num_tiles: int, l2: CacheParams,
+                    num_slices: int) -> "DirectoryParams":
+        assoc = cfg.get_int("dram_directory/associativity")
+        entries_str = cfg.get_str("dram_directory/total_entries")
+        if entries_str == "auto":
+            # Cover 2x the aggregate L2 capacity, spread over the directory
+            # slices, rounded up to a power-of-2 set count (same sizing rule
+            # as the reference, directory_cache.cc:249-256).
+            sets = math.ceil(2.0 * l2.size_kb * 1024 * num_tiles /
+                             (l2.line_size * assoc * num_slices))
+            sets = _ceil_pow2(sets)
+            total_entries = sets * assoc
+        else:
+            try:
+                total_entries = int(entries_str)
+            except ValueError:
+                raise ConfigError(
+                    f"dram_directory/total_entries must be 'auto' or an integer: {entries_str!r}"
+                ) from None
+
+        access_str = cfg.get_str("dram_directory/access_time")
+        if access_str == "auto":
+            access = _auto_directory_access_cycles(
+                total_entries, num_tiles, cfg.get_int("dram_directory/max_hw_sharers"))
+        else:
+            try:
+                access = int(access_str)
+            except ValueError:
+                raise ConfigError(
+                    f"dram_directory/access_time must be 'auto' or an integer: {access_str!r}"
+                ) from None
+
+        return cls(
+            total_entries=total_entries,
+            associativity=assoc,
+            max_hw_sharers=cfg.get_int("dram_directory/max_hw_sharers"),
+            directory_type=cfg.get_str("dram_directory/directory_type"),
+            access_cycles=access,
+            limitless_trap_cycles=cfg.get_int("limitless/software_trap_penalty"),
+        )
+
+
+def _auto_directory_access_cycles(total_entries: int, num_tiles: int,
+                                  max_hw_sharers: int) -> int:
+    """Size-binned access latency, as in the reference's auto table
+    (directory_cache.cc:300-322): bigger structure -> more cycles."""
+    # Entry size ~ state byte + sharer bitmap over the tracked sharers.
+    entry_bytes = 1 + max(4, max_hw_sharers // 8)
+    size_kb = math.ceil(total_entries * entry_bytes / 1024)
+    for bound, cycles in ((16, 1), (32, 2), (64, 4), (128, 6), (256, 8),
+                          (512, 10), (1024, 13), (2048, 16)):
+        if size_kb <= bound:
+            return cycles
+    return 20
+
+
+@dataclasses.dataclass(frozen=True)
+class DramParams:
+    """DRAM controller timing (reference: [dram] section;
+    dram_perf_model.h:19-60 latency = access cost + size/bandwidth +
+    queueing delay)."""
+
+    latency_ns: float
+    per_controller_bandwidth_gbps: float
+    num_controllers: int          # resolved count (ALL -> num_tiles)
+    controller_home_stride: int   # tiles between successive controllers
+    queue_model_enabled: bool
+    queue_model_type: str
+
+    @property
+    def latency_ps(self) -> int:
+        return int(ns_to_ps(self.latency_ns))
+
+    def processing_ps_per_line(self, line_size: int) -> int:
+        # bytes / (GB/s) = ns; serialization cost per cache line.
+        return int(round(line_size / self.per_controller_bandwidth_gbps * 1000))
+
+    @classmethod
+    def from_config(cls, cfg: Config, num_tiles: int) -> "DramParams":
+        raw = cfg.get_str("dram/num_controllers")
+        if raw.strip().upper() == "ALL":
+            n = num_tiles
+        else:
+            try:
+                n = int(raw)
+            except ValueError:
+                raise ConfigError(
+                    f"dram/num_controllers must be 'ALL' or an integer: {raw!r}") from None
+            if n <= 0 or n > num_tiles:
+                raise ConfigError(f"dram/num_controllers out of range: {n}")
+        stride = max(1, num_tiles // n)
+        return cls(
+            latency_ns=cfg.get_float("dram/latency"),
+            per_controller_bandwidth_gbps=cfg.get_float("dram/per_controller_bandwidth"),
+            num_controllers=n,
+            controller_home_stride=stride,
+            queue_model_enabled=cfg.get_bool("dram/queue_model/enabled"),
+            queue_model_type=cfg.get_str("dram/queue_model/type"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkParams:
+    """One logical network's model selection + constants (reference:
+    [network] + per-model sections; models enumerated in
+    common/network/network_model.h and common/network/models/)."""
+
+    model: str                 # magic | emesh_hop_counter | emesh_hop_by_hop | atac
+    flit_width_bits: int
+    router_delay_cycles: int
+    link_delay_cycles: int
+    queue_model_enabled: bool
+    queue_model_type: str
+    broadcast_tree_enabled: bool
+
+    @classmethod
+    def from_config(cls, cfg: Config, which: str) -> "NetworkParams":
+        model = cfg.get_str(f"network/{which}")
+        sec = f"network/{model}"
+        if model == "magic":
+            return cls(model, 64, 0, 0, False, "none", False)
+        return cls(
+            model=model,
+            flit_width_bits=cfg.get_int(f"{sec}/flit_width", 64),
+            router_delay_cycles=cfg.get_int(f"{sec}/router/delay", 1),
+            link_delay_cycles=cfg.get_int(f"{sec}/link/delay", 1),
+            queue_model_enabled=cfg.get_bool(f"{sec}/queue_model/enabled", False),
+            queue_model_type=cfg.get_str(f"{sec}/queue_model/type", "history_tree"),
+            broadcast_tree_enabled=cfg.get_bool(f"{sec}/broadcast_tree_enabled", False),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CoreParams:
+    """Core model selection + static costs (reference: [tile]/model_list,
+    [core/static_instruction_costs], [branch_predictor],
+    core model registry common/tile/core/core_model.cc:15)."""
+
+    model: str                    # 'simple' | 'iocoom'
+    static_costs: Tuple[int, ...]  # indexed by InstructionType order
+    bp_type: str
+    bp_size: int
+    bp_mispredict_penalty: int
+    # iocoom knobs (reference: [core/iocoom], carbon_sim.cfg:180-186)
+    load_queue_entries: int
+    store_queue_entries: int
+    speculative_loads: bool
+    multiple_outstanding_rfos: bool
+
+    @classmethod
+    def from_config(cls, cfg: Config, core_type: str) -> "CoreParams":
+        costs = tuple(
+            cfg.get_int(f"core/static_instruction_costs/{t.config_key}")
+            for t in STATIC_COST_TYPES
+        )
+        return cls(
+            model=core_type,
+            static_costs=costs,
+            bp_type=cfg.get_str("branch_predictor/type"),
+            bp_size=cfg.get_int("branch_predictor/size"),
+            bp_mispredict_penalty=cfg.get_int("branch_predictor/mispredict_penalty"),
+            load_queue_entries=cfg.get_int("core/iocoom/num_load_queue_entries"),
+            store_queue_entries=cfg.get_int("core/iocoom/num_store_queue_entries"),
+            speculative_loads=cfg.get_bool("core/iocoom/speculative_loads_enabled"),
+            multiple_outstanding_rfos=cfg.get_bool("core/iocoom/multiple_outstanding_RFOs_enabled"),
+        )
+
+
+_MODEL_LIST_RE = re.compile(r"<([^>]*)>")
+
+
+def parse_tile_model_list(raw: str) -> Tuple[Tuple[str, str, str, str, str], ...]:
+    """Parse [tile]/model_list tuples
+    ``<count, core-type, l1i, l1d, l2>`` (reference: carbon_sim.cfg:158-176)."""
+    tuples = []
+    for m in _MODEL_LIST_RE.finditer(raw):
+        fields = [f.strip() for f in m.group(1).split(",")]
+        if len(fields) != 5:
+            raise ConfigError(f"bad tile model tuple: <{m.group(1)}>")
+        tuples.append(tuple(fields))
+    if not tuples:
+        raise ConfigError(f"no tile model tuples in {raw!r}")
+    return tuple(tuples)
+
+
+def parse_dvfs_domains(raw: str) -> Tuple[Tuple[float, Tuple[int, ...]], ...]:
+    """Parse [dvfs]/domains ``<freq, MODULE, ...>`` tuples into
+    (freq_ghz, module-ids) pairs (reference: carbon_sim.cfg:147-151,
+    dvfs_manager.h:19-88)."""
+    domains = []
+    for m in _MODEL_LIST_RE.finditer(raw):
+        fields = [f.strip() for f in m.group(1).split(",") if f.strip()]
+        try:
+            freq = float(fields[0])
+            modules = tuple(int(DVFSModule.parse(f)) for f in fields[1:])
+        except (IndexError, ValueError, KeyError):
+            raise ConfigError(f"bad dvfs domain tuple: <{m.group(1)}>") from None
+        domains.append((freq, modules))
+    if not domains:
+        raise ConfigError(f"no dvfs domains in {raw!r}")
+    return tuple(domains)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimParams:
+    """All static parameters of one simulation run."""
+
+    num_tiles: int
+    mesh_width: int
+    mesh_height: int
+    max_frequency_ghz: float
+    quantum_ps: int
+    clock_skew_scheme: str
+
+    core: CoreParams
+    l1i: CacheParams
+    l1d: CacheParams
+    l2: CacheParams
+    protocol: str
+    l2_directory_type: str
+    l2_max_hw_sharers: int
+    directory: DirectoryParams
+    dram: DramParams
+    net_user: NetworkParams
+    net_memory: NetworkParams
+
+    dvfs_domains: Tuple[Tuple[float, Tuple[int, ...]], ...]
+    dvfs_sync_delay_cycles: int
+
+    enable_core_modeling: bool
+    enable_power_modeling: bool
+    technology_node: int
+
+    # TPU engine knobs
+    max_events_per_quantum: int
+    directory_conflict_rounds: int
+    quanta_per_step: int
+
+    @property
+    def line_size(self) -> int:
+        return self.l2.line_size
+
+    def module_freq_ghz(self, module: DVFSModule) -> float:
+        """Initial frequency of a module from its DVFS domain."""
+        for freq, modules in self.dvfs_domains:
+            if int(module) in modules:
+                return freq
+        return self.max_frequency_ghz
+
+    @classmethod
+    def from_config(cls, cfg: Config, num_tiles: Optional[int] = None) -> "SimParams":
+        T = num_tiles if num_tiles is not None else cfg.get_int("general/total_cores")
+        mesh_w = int(math.floor(math.sqrt(T)))
+        mesh_h = int(math.ceil(T / mesh_w))
+
+        tiles = parse_tile_model_list(cfg.get_str("tile/model_list"))
+        # v1: homogeneous tiles — take the first tuple's models.
+        _, core_type, l1i_name, l1d_name, l2_name = tiles[0]
+        if core_type == "default":
+            core_type = "simple"
+        l1i_name = "T1" if l1i_name == "default" else l1i_name
+        l1d_name = "T1" if l1d_name == "default" else l1d_name
+        l2_name = "T1" if l2_name == "default" else l2_name
+
+        l1i = CacheParams.from_config(cfg, f"l1_icache/{l1i_name}", "l1_icache")
+        l1d = CacheParams.from_config(cfg, f"l1_dcache/{l1d_name}", "l1_dcache")
+        l2 = CacheParams.from_config(cfg, f"l2_cache/{l2_name}", "l2_cache")
+
+        dram = DramParams.from_config(cfg, T)
+        directory = DirectoryParams.from_config(cfg, T, l2, num_slices=dram.num_controllers)
+
+        scheme = cfg.get_str("clock_skew_management/scheme")
+        if scheme == "lax_p2p":
+            scheme = "lax_barrier"  # subsumed on TPU (see SURVEY.md section 5.7)
+        quantum_ns = cfg.get_int("clock_skew_management/lax_barrier/quantum")
+
+        return cls(
+            num_tiles=T,
+            mesh_width=mesh_w,
+            mesh_height=mesh_h,
+            max_frequency_ghz=cfg.get_float("general/max_frequency"),
+            quantum_ps=int(ns_to_ps(quantum_ns)),
+            clock_skew_scheme=scheme,
+            core=CoreParams.from_config(cfg, core_type),
+            l1i=l1i,
+            l1d=l1d,
+            l2=l2,
+            protocol=cfg.get_str("caching_protocol/type"),
+            l2_directory_type=cfg.get_str("l2_directory/directory_type"),
+            l2_max_hw_sharers=cfg.get_int("l2_directory/max_hw_sharers"),
+            directory=directory,
+            dram=dram,
+            net_user=NetworkParams.from_config(cfg, "user"),
+            net_memory=NetworkParams.from_config(cfg, "memory"),
+            dvfs_domains=parse_dvfs_domains(cfg.get_str("dvfs/domains")),
+            dvfs_sync_delay_cycles=cfg.get_int("dvfs/synchronization_delay"),
+            enable_core_modeling=cfg.get_bool("general/enable_core_modeling"),
+            enable_power_modeling=cfg.get_bool("general/enable_power_modeling"),
+            technology_node=cfg.get_int("general/technology_node"),
+            max_events_per_quantum=cfg.get_int("tpu/max_events_per_quantum"),
+            directory_conflict_rounds=cfg.get_int("tpu/directory_conflict_rounds"),
+            quanta_per_step=cfg.get_int("tpu/quanta_per_step"),
+        )

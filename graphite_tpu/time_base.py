@@ -1,0 +1,52 @@
+"""Simulated-time conventions.
+
+Simulated time is an int64 count of **picoseconds**, matching the
+reference's Time type (reference: common/misc/time_types.h:7-60).  Model
+latencies are specified in cycles at some module frequency (GHz) and
+converted to picoseconds at use, matching the reference's frequency-aware
+Latency type (time_types.h Latency).
+
+Inside jitted kernels, frequencies ride along as float64 arrays (per tile
+or per DVFS domain) so DVFS can change them at run time; conversions
+round-half-up like the reference's double->UInt64 conversion.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+PS_PER_NS = 1000
+PS_PER_US = 1000_000
+PS_PER_S = 10**12
+
+# A sentinel "never" time for wakeup lists / termination checks.
+TIME_MAX = np.int64(2**62)
+
+
+def cycles_to_ps(cycles, freq_ghz):
+    """Convert a cycle count at ``freq_ghz`` to int64 picoseconds.
+
+    ps = cycles * 1000 / freq_ghz, rounded to nearest (reference converts
+    through double ns; we keep float64 which is exact for all practical
+    cycle counts < 2**52).
+    """
+    return jnp.int64(jnp.round(jnp.float64(cycles) * (PS_PER_NS / 1.0) / jnp.float64(freq_ghz)))
+
+
+def ps_to_cycles(ps, freq_ghz):
+    """Convert int64 picoseconds to a cycle count at ``freq_ghz`` (rounded)."""
+    return jnp.int64(jnp.round(jnp.float64(ps) * jnp.float64(freq_ghz) / PS_PER_NS))
+
+
+def ns_to_ps(ns) -> np.int64:
+    return np.int64(round(float(ns) * PS_PER_NS))
+
+
+def ps_to_ns(ps) -> float:
+    return float(ps) / PS_PER_NS
+
+
+def period_ps(freq_ghz) -> float:
+    """Picoseconds per cycle at ``freq_ghz`` (float; multiply then round)."""
+    return PS_PER_NS / float(freq_ghz)

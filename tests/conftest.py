@@ -1,0 +1,16 @@
+"""Test harness: run everything on CPU with 8 virtual devices so sharding
+over the tile axis is exercised without TPU hardware (the driver's
+dryrun_multichip uses the same trick)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,62 @@
+"""Config system tests (parity model: reference common/config/test/)."""
+
+import pytest
+
+from graphite_tpu.config import Config, ConfigError, load_config, parse_overrides
+
+
+def test_defaults_load():
+    cfg = load_config()
+    assert cfg.get_int("general/total_cores") == 64
+    assert cfg.get_float("general/max_frequency") == 2.0
+    assert cfg.get_bool("general/enable_shared_mem") is True
+    assert cfg.get_str("caching_protocol/type") == "pr_l1_pr_l2_dram_directory_msi"
+    assert cfg.get_int("clock_skew_management/lax_barrier/quantum") == 1000
+
+
+def test_nested_sections_and_comments():
+    cfg = Config.from_text(
+        """
+        [a]
+        x = 1            # trailing comment
+        [a/b/c]
+        y = "hash # inside quotes"
+        flag = true
+        f = 2.5
+        """
+    )
+    assert cfg.get_int("a/x") == 1
+    assert cfg.get_str("a/b/c/y") == "hash # inside quotes"
+    assert cfg.get_bool("a/b/c/flag") is True
+    assert cfg.get_float("a/b/c/f") == 2.5
+
+
+def test_layering_and_overrides():
+    cfg = load_config(argv=["prog", "--general/total_cores=256",
+                            "--network/memory=magic", "positional"])
+    assert cfg.get_int("general/total_cores") == 256
+    assert cfg.get_str("network/memory") == "magic"
+    # non-override args pass through
+    overrides, rest = parse_overrides(["--a/b=1", "-c", "file.cfg", "--flag"])
+    assert overrides == [("a/b", "1")]
+    assert rest == ["-c", "file.cfg", "--flag"]
+
+
+def test_missing_key_raises():
+    cfg = Config.from_text("[a]\nx = 1\n")
+    with pytest.raises(ConfigError):
+        cfg.get_int("a/missing")
+    assert cfg.get_int("a/missing", 7) == 7
+
+
+def test_get_list():
+    cfg = Config.from_text('[s]\nitems = "a, b , c"\nempty = ""\n')
+    assert cfg.get_list("s/items") == ["a", "b", "c"]
+    assert cfg.get_list("s/empty") == []
+
+
+def test_roundtrip_text():
+    cfg = load_config()
+    cfg2 = Config.from_text(cfg.to_text())
+    assert cfg2.get_int("l2_cache/T1/cache_size") == 512
+    assert cfg2.get_str("dvfs/domains") == cfg.get_str("dvfs/domains")

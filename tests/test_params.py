@@ -1,0 +1,67 @@
+"""SimParams derivation tests (model-factory boundary)."""
+
+import pytest
+
+from graphite_tpu.config import ConfigError, load_config
+from graphite_tpu.params import (
+    SimParams,
+    parse_dvfs_domains,
+    parse_tile_model_list,
+)
+from graphite_tpu.isa import DVFSModule
+
+
+def test_default_params():
+    p = SimParams.from_config(load_config())
+    assert p.num_tiles == 64
+    assert p.mesh_width == 8 and p.mesh_height == 8
+    assert p.quantum_ps == 1_000_000  # 1000 ns
+    # T1 geometries: 32KB/64B/4-way = 128 sets; 512KB/64B/8-way = 1024 sets.
+    assert p.l1d.num_sets == 128
+    assert p.l2.num_sets == 1024
+    assert p.l1d.access_cycles == 1       # parallel max(1,1)
+    assert p.l2.access_cycles == 8        # parallel max(3,8)
+    assert p.core.model == "simple"
+    assert p.core.static_costs[0] == 1    # generic
+    assert p.core.static_costs[4] == 18   # idiv
+
+
+def test_directory_auto_sizing():
+    p = SimParams.from_config(load_config())
+    # auto: sets = ceil(2*512KB*1024*64 / (64*16*64)) = 1024 -> pow2 already.
+    assert p.directory.num_sets == 1024
+    assert p.directory.total_entries == 1024 * 16
+    assert p.directory.access_cycles >= 1
+
+
+def test_dram_controllers_all():
+    p = SimParams.from_config(load_config())
+    assert p.dram.num_controllers == 64
+    assert p.dram.latency_ps == 100_000
+    # 64B / 5GB/s = 12.8 ns -> 13 ns rounded... stored in ps
+    assert p.dram.processing_ps_per_line(64) == 12800
+
+
+def test_non_square_mesh():
+    p = SimParams.from_config(load_config(), num_tiles=48)
+    assert p.mesh_width == 6 and p.mesh_height == 8
+    assert p.mesh_width * p.mesh_height >= 48
+
+
+def test_parse_tile_model_list():
+    t = parse_tile_model_list("<default,iocoom,T1,T1,T1>")
+    assert t == (("default", "iocoom", "T1", "T1", "T1"),)
+    with pytest.raises(ConfigError):
+        parse_tile_model_list("garbage")
+
+
+def test_parse_dvfs_domains():
+    d = parse_dvfs_domains("<1.0, CORE, L1_ICACHE>, <2.0, L2_CACHE>")
+    assert d[0][0] == 1.0
+    assert int(DVFSModule.CORE) in d[0][1]
+    assert d[1] == (2.0, (int(DVFSModule.L2_CACHE),))
+
+
+def test_module_freq_lookup():
+    p = SimParams.from_config(load_config())
+    assert p.module_freq_ghz(DVFSModule.CORE) == 1.0  # default domain at 1.0
