@@ -1,0 +1,3 @@
+"""Event streams: the frontend <-> timing-engine contract (see schema.py)."""
+
+from graphite_tpu.events.schema import Trace, TraceBuilder  # noqa: F401

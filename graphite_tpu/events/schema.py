@@ -1,0 +1,250 @@
+"""Per-tile event-stream schema — the frontend/engine contract.
+
+This is the TPU build's analog of the reference's Pin analysis-call feed:
+one record per *modeled step* of a tile, covering the union of what the
+reference's instrumentation delivers to the timing models —
+instruction decode + queue (reference: pin/instruction_modeling.cc:350-410),
+lite-mode memory modeling (reference: pin/lite/memory_modeling.cc:13-57),
+user messaging / sync / spawn dynamic instructions (reference:
+common/tile/core/instruction.h:166-200), and thread lifecycle.
+
+Layout: structure-of-arrays, fixed shape ``[num_tiles, num_events]``,
+padded with NOP so every tile's stream has the same length (static shapes
+for XLA).  Field meaning depends on the opcode (see ``EventOp``):
+
+===============  =====================  ==============  =======================
+op               addr (int64)           arg (int32)     arg2 (int32)
+===============  =====================  ==============  =======================
+NOP              -                      -               -
+COMPUTE          block start pc         cost (cycles)   instruction count
+MEM_READ         byte address           size (bytes)    0 / 1 = line-split cont.
+MEM_WRITE        byte address           size (bytes)    0 / 1 = line-split cont.
+ATOMIC           byte address           size (bytes)    0 / 1 = line-split cont.
+BRANCH           pc                     taken (0/1)     0
+SEND             -                      size (bytes)    destination tile
+RECV             -                      size (bytes)    source tile
+BARRIER_WAIT     -                      barrier id      participant count
+MUTEX_LOCK       -                      mutex id        0
+MUTEX_UNLOCK     -                      mutex id        0
+SYNC             wake time (ps)         cost (cycles)   0
+SPAWN            -                      cost (cycles)   child tile
+STALL            until time (ps)        -               0
+DVFS_SET         -                      module id       frequency (MHz)
+DONE             -                      -               -
+===============  =====================  ==============  =======================
+
+Conventions the frontend must uphold (mirroring reference behavior):
+  * Memory accesses are split at cache-line boundaries by the *frontend*
+    (the reference splits them in Core::initiateMemoryAccess,
+    common/tile/core/core.cc:173-245); the engine models one line per
+    MEM_* event.
+  * COMPUTE collapses a run of non-memory, non-branch instructions into an
+    aggregate (cost, icount) pair; cost is the sum of the static per-type
+    costs the reference reads from [core/static_instruction_costs]
+    (carbon_sim.cfg:189-200).  The engine models instruction fetch for the
+    block from `addr` assuming a mean 4-byte encoding.
+  * Streams end with one DONE; slots after it are NOP padding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from graphite_tpu.isa import EventOp, InstructionType
+
+__all__ = ["Trace", "TraceBuilder", "EventOp"]
+
+# Mean instruction encoding length assumed when modeling i-fetch for a
+# COMPUTE block (x86 averages ~3.7 bytes; the reference fetches each
+# instruction's true bytes via Pin, which a trace no longer carries).
+ICACHE_BYTES_PER_INSTRUCTION = 4
+
+
+@dataclasses.dataclass
+class Trace:
+    """A complete per-tile event-stream bundle (numpy; device placement is
+    the engine's job)."""
+
+    ops: np.ndarray    # [T, N] int32 (EventOp)
+    addr: np.ndarray   # [T, N] int64
+    arg: np.ndarray    # [T, N] int32
+    arg2: np.ndarray   # [T, N] int32
+
+    @property
+    def num_tiles(self) -> int:
+        return self.ops.shape[0]
+
+    @property
+    def num_events(self) -> int:
+        return self.ops.shape[1]
+
+    def __post_init__(self):
+        shape = self.ops.shape
+        for name in ("addr", "arg", "arg2"):
+            a = getattr(self, name)
+            if a.shape != shape:
+                raise ValueError(f"trace field {name} shape {a.shape} != {shape}")
+        self.ops = self.ops.astype(np.int32, copy=False)
+        self.addr = self.addr.astype(np.int64, copy=False)
+        self.arg = self.arg.astype(np.int32, copy=False)
+        self.arg2 = self.arg2.astype(np.int32, copy=False)
+
+    # -------------------------------------------------------------- io
+
+    def save(self, path: str) -> None:
+        np.savez_compressed(
+            path, ops=self.ops, addr=self.addr, arg=self.arg, arg2=self.arg2
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "Trace":
+        with np.load(path) as z:
+            return cls(ops=z["ops"], addr=z["addr"], arg=z["arg"], arg2=z["arg2"])
+
+    # ------------------------------------------------------------ utility
+
+    def instruction_count(self) -> int:
+        """Total modeled instructions across all tiles (for MIPS math).
+        Line-split continuation events (arg2=1 on MEM_*) belong to the
+        same instruction as their predecessor and are not re-counted."""
+        ops = self.ops
+        n = int(np.sum(np.where(ops == EventOp.COMPUTE, self.arg2, 0)))
+        mem = np.isin(ops, (EventOp.MEM_READ, EventOp.MEM_WRITE, EventOp.ATOMIC))
+        n += int(np.sum(mem & (self.arg2 == 0)))
+        n += int(np.sum(ops == EventOp.BRANCH))
+        return n
+
+    def pad_to(self, num_events: int) -> "Trace":
+        if num_events < self.num_events:
+            raise ValueError("pad_to cannot shrink a trace")
+        if num_events == self.num_events:
+            return self
+        T, N = self.ops.shape
+        pad = num_events - N
+
+        def _pad(a, dtype):
+            return np.concatenate(
+                [a, np.zeros((T, pad), dtype=dtype)], axis=1)
+
+        return Trace(
+            ops=_pad(self.ops, np.int32),
+            addr=_pad(self.addr, np.int64),
+            arg=_pad(self.arg, np.int32),
+            arg2=_pad(self.arg2, np.int32),
+        )
+
+
+class TraceBuilder:
+    """Append-style builder for one trace: per-tile event lists packed into
+    the dense [T, N] layout (the software analog of the reference's
+    per-thread analysis-call sequence)."""
+
+    def __init__(self, num_tiles: int, line_size: int = 64,
+                 static_costs: Optional[Dict[InstructionType, int]] = None):
+        self.num_tiles = num_tiles
+        self.line_size = line_size
+        self.static_costs = static_costs or {}
+        self._events: List[List[Tuple[int, int, int, int]]] = [
+            [] for _ in range(num_tiles)
+        ]
+        self._done = [False] * num_tiles
+
+    # ----------------------------------------------------------- emitters
+
+    def _emit(self, tile: int, op: EventOp, addr: int = 0, arg: int = 0,
+              arg2: int = 0) -> None:
+        if self._done[tile]:
+            raise ValueError(f"tile {tile} already DONE")
+        self._events[tile].append((int(op), int(addr), int(arg), int(arg2)))
+
+    def compute(self, tile: int, cost_cycles: int, icount: int,
+                pc: int = 0x400000) -> None:
+        self._emit(tile, EventOp.COMPUTE, pc, cost_cycles, icount)
+
+    def instructions(self, tile: int, types: Sequence[InstructionType],
+                     pc: int = 0x400000) -> None:
+        """Convenience: collapse a typed instruction run via the builder's
+        static-cost table (what a real frontend does at decode)."""
+        cost = sum(self.static_costs[t] for t in types)
+        self.compute(tile, cost, len(types), pc)
+
+    def _mem(self, tile: int, op: EventOp, addr: int, size: int) -> None:
+        # Line-splitting happens here, as in the reference's core entry
+        # (core.cc:173-245): one event per touched line.  Continuation
+        # events of a straddling access carry arg2=1 so instruction
+        # counting attributes the whole access to one instruction.
+        end = addr + max(1, size)
+        line = self.line_size
+        a = addr
+        first = True
+        while a < end:
+            line_end = (a // line + 1) * line
+            chunk = min(end, line_end) - a
+            self._emit(tile, op, a, chunk, 0 if first else 1)
+            a += chunk
+            first = False
+
+    def read(self, tile: int, addr: int, size: int = 8) -> None:
+        self._mem(tile, EventOp.MEM_READ, addr, size)
+
+    def write(self, tile: int, addr: int, size: int = 8) -> None:
+        self._mem(tile, EventOp.MEM_WRITE, addr, size)
+
+    def atomic(self, tile: int, addr: int, size: int = 8) -> None:
+        self._mem(tile, EventOp.ATOMIC, addr, size)
+
+    def branch(self, tile: int, taken: bool, pc: int = 0x400000) -> None:
+        self._emit(tile, EventOp.BRANCH, pc, int(taken), 0)
+
+    def send(self, tile: int, dst: int, size: int = 8) -> None:
+        self._emit(tile, EventOp.SEND, 0, size, dst)
+
+    def recv(self, tile: int, src: int, size: int = 8) -> None:
+        self._emit(tile, EventOp.RECV, 0, size, src)
+
+    def barrier(self, tile: int, barrier_id: int, participants: int) -> None:
+        self._emit(tile, EventOp.BARRIER_WAIT, 0, barrier_id, participants)
+
+    def mutex_lock(self, tile: int, mutex_id: int) -> None:
+        self._emit(tile, EventOp.MUTEX_LOCK, 0, mutex_id, 0)
+
+    def mutex_unlock(self, tile: int, mutex_id: int) -> None:
+        self._emit(tile, EventOp.MUTEX_UNLOCK, 0, mutex_id, 0)
+
+    def stall_until(self, tile: int, time_ps: int) -> None:
+        self._emit(tile, EventOp.STALL, time_ps, 0, 0)
+
+    def dvfs_set(self, tile: int, module: int, freq_ghz: float) -> None:
+        self._emit(tile, EventOp.DVFS_SET, 0, module, int(round(freq_ghz * 1000)))
+
+    def done(self, tile: int) -> None:
+        self._emit(tile, EventOp.DONE)
+        self._done[tile] = True
+
+    # ------------------------------------------------------------- finish
+
+    def build(self, min_events: Optional[int] = None) -> Trace:
+        for t in range(self.num_tiles):
+            if not self._done[t]:
+                self.done(t)
+        n = max(len(ev) for ev in self._events)
+        if min_events is not None:
+            n = max(n, min_events)
+        T = self.num_tiles
+        ops = np.zeros((T, n), dtype=np.int32)
+        addr = np.zeros((T, n), dtype=np.int64)
+        arg = np.zeros((T, n), dtype=np.int32)
+        arg2 = np.zeros((T, n), dtype=np.int32)
+        for t, evs in enumerate(self._events):
+            if not evs:
+                continue
+            rec = np.asarray(evs, dtype=np.int64)
+            k = len(evs)
+            ops[t, :k] = rec[:, 0]
+            addr[t, :k] = rec[:, 1]
+            arg[t, :k] = rec[:, 2]
+            arg2[t, :k] = rec[:, 3]
+        return Trace(ops=ops, addr=addr, arg=arg, arg2=arg2)

@@ -1,0 +1,226 @@
+"""Synthetic trace generators — the first event source.
+
+These play the role of the reference's synthetic test applications
+(reference: tests/benchmarks/synthetic_memory/synthetic_memory.cc,
+tests/benchmarks/synthetic_network/) and of the unit tests' hand-driven
+access sequences (reference: tests/unit/shared_mem_basic/shared_mem_basic.cc:16-44):
+deterministic per-tile event streams with controlled compute/memory mixes
+and sharing patterns, used for golden-timing tests and benchmarking before
+a live (Pin-equivalent) frontend exists.
+
+Address-space convention: each tile's private heap lives at
+``PRIVATE_BASE + tile * PRIVATE_SPAN``; shared regions live under
+``SHARED_BASE``.  Addresses are synthetic — the engine only hashes them
+(timing-only simulation, like the reference's lite mode).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from graphite_tpu.events.schema import (
+    ICACHE_BYTES_PER_INSTRUCTION, Trace, TraceBuilder)
+from graphite_tpu.isa import EventOp
+
+PRIVATE_BASE = 0x1000_0000
+PRIVATE_SPAN = 0x0100_0000
+SHARED_BASE = 0x8000_0000
+
+
+def gen_compute(num_tiles: int, blocks: int = 100, cost_cycles: int = 50,
+                icount_per_block: int = 50) -> Trace:
+    """Pure-compute streams: golden total time = blocks * cost (+ i-fetch)."""
+    tb = TraceBuilder(num_tiles)
+    for t in range(num_tiles):
+        pc = 0x400000
+        for _ in range(blocks):
+            tb.compute(t, cost_cycles, icount_per_block, pc=pc)
+            pc += icount_per_block * ICACHE_BYTES_PER_INSTRUCTION
+    return tb.build()
+
+
+def gen_private_mem(num_tiles: int, accesses: int = 1000,
+                    working_set_kb: int = 16, read_fraction: float = 0.7,
+                    compute_cycles: int = 5, seed: int = 0,
+                    line_size: int = 64) -> Trace:
+    """Uniform-random accesses within each tile's private working set.
+
+    With working_set <= L1D size this is an all-hit stream; larger working
+    sets sweep the L1/L2/DRAM hit-rate curve — the same knob the reference's
+    synthetic_memory benchmark exposes.
+    """
+    rng = np.random.default_rng(seed)
+    tb = TraceBuilder(num_tiles, line_size=line_size)
+    span = working_set_kb * 1024
+    for t in range(num_tiles):
+        base = PRIVATE_BASE + t * PRIVATE_SPAN
+        offsets = (rng.integers(0, span // 8, size=accesses) * 8)
+        reads = rng.random(accesses) < read_fraction
+        for i in range(accesses):
+            if compute_cycles:
+                tb.compute(t, compute_cycles, compute_cycles)
+            a = int(base + offsets[i])
+            if reads[i]:
+                tb.read(t, a, 8)
+            else:
+                tb.write(t, a, 8)
+    return tb.build()
+
+
+def gen_stream(num_tiles: int, lines: int = 2048, passes: int = 1,
+               write: bool = False, line_size: int = 64) -> Trace:
+    """Sequential streaming over a private buffer (DRAM-bandwidth shaped)."""
+    tb = TraceBuilder(num_tiles, line_size=line_size)
+    for t in range(num_tiles):
+        base = PRIVATE_BASE + t * PRIVATE_SPAN
+        for _ in range(passes):
+            for i in range(lines):
+                a = base + i * line_size
+                if write:
+                    tb.write(t, a, 8)
+                else:
+                    tb.read(t, a, 8)
+    return tb.build()
+
+
+def gen_shared_readers(num_tiles: int, lines: int = 64, passes: int = 4,
+                       line_size: int = 64) -> Trace:
+    """All tiles read the same shared region: exercises S-state sharing
+    (every line ends with all tiles in the sharer bitmap)."""
+    tb = TraceBuilder(num_tiles, line_size=line_size)
+    for t in range(num_tiles):
+        for _ in range(passes):
+            for i in range(lines):
+                tb.read(t, SHARED_BASE + i * line_size, 8)
+    return tb.build()
+
+
+def gen_migratory(num_tiles: int, lines: int = 16, rounds: int = 8,
+                  line_size: int = 64) -> Trace:
+    """Migratory sharing: tiles take turns read-modify-writing shared lines
+    (exercises M->flush->M ping-pong, the reference's shared_mem_test
+    pattern, tests/unit/shared_mem_test*/)."""
+    tb = TraceBuilder(num_tiles, line_size=line_size)
+    for r in range(rounds):
+        for t in range(num_tiles):
+            for i in range(lines):
+                a = SHARED_BASE + i * line_size
+                tb.read(t, a, 8)
+                tb.write(t, a, 8)
+            tb.compute(t, 20, 20)
+    return tb.build()
+
+
+def gen_ping_pong(num_tiles: int, messages: int = 32,
+                  size: int = 64) -> Trace:
+    """CAPI ping-pong between tile pairs (reference: tests/apps/ping_pong)."""
+    if num_tiles % 2:
+        raise ValueError("ping_pong needs an even tile count")
+    tb = TraceBuilder(num_tiles)
+    for a in range(0, num_tiles, 2):
+        b = a + 1
+        for _ in range(messages):
+            tb.send(a, b, size)
+            tb.recv(b, a, size)
+            tb.send(b, a, size)
+            tb.recv(a, b, size)
+    return tb.build()
+
+
+def gen_barrier_compute(num_tiles: int, phases: int = 8,
+                        max_cost: int = 400, seed: int = 0) -> Trace:
+    """Unbalanced compute phases separated by global barriers (exercises the
+    sync server path, reference: common/system/sync_server.h SimBarrier)."""
+    rng = np.random.default_rng(seed)
+    tb = TraceBuilder(num_tiles)
+    for p in range(phases):
+        costs = rng.integers(max_cost // 4, max_cost, size=num_tiles)
+        for t in range(num_tiles):
+            tb.compute(t, int(costs[t]), int(costs[t]))
+            tb.barrier(t, 0, num_tiles)
+    return tb.build()
+
+
+def gen_lock_contention(num_tiles: int, acquisitions: int = 16,
+                        critical_cycles: int = 50) -> Trace:
+    """All tiles repeatedly take one mutex (reference: tests/unit/many_mutex)."""
+    tb = TraceBuilder(num_tiles)
+    for k in range(acquisitions):
+        for t in range(num_tiles):
+            tb.mutex_lock(t, 0)
+            tb.compute(t, critical_cycles, critical_cycles)
+            tb.mutex_unlock(t, 0)
+    return tb.build()
+
+
+def gen_radix(num_tiles: int, keys_per_tile: int = 4096, radix: int = 256,
+              seed: int = 0, line_size: int = 64,
+              max_events_per_tile: int | None = None) -> Trace:
+    """Address-accurate SPLASH-2 radix-sort trace (reference:
+    tests/benchmarks/radix/radix.C vendored from SPLASH-2).
+
+    Reproduces the memory behavior of one digit-pass of the parallel radix
+    sort: (1) local histogram of each tile's keys (sequential key reads +
+    scattered count increments), (2) barrier, (3) parallel prefix over the
+    per-tile histograms (reads of other tiles' shared count arrays),
+    (4) barrier, (5) permutation writes of keys to their globally-ranked
+    positions (scattered writes into the shared output array).  Compute
+    events between accesses model the ~10 arithmetic ops per key of the
+    original loop bodies.
+    """
+    rng = np.random.default_rng(seed)
+    tb = TraceBuilder(num_tiles, line_size=line_size)
+    n_total = keys_per_tile * num_tiles
+    keys = rng.integers(0, radix, size=(num_tiles, keys_per_tile))
+
+    key_array = PRIVATE_BASE           # per-tile key input (private span)
+    hist_array = SHARED_BASE           # [num_tiles, radix] shared histograms
+    out_array = SHARED_BASE + 0x400_0000  # shared sorted output
+
+    # Global ranks for the permutation phase (computed once, host side).
+    flat = keys.reshape(-1)
+    order = np.argsort(flat, kind="stable")
+    rank = np.empty_like(order)
+    rank[order] = np.arange(n_total)
+    rank = rank.reshape(num_tiles, keys_per_tile)
+
+    for t in range(num_tiles):
+        base = key_array + t * PRIVATE_SPAN
+        # Phase 1: histogram — read key, bump count.
+        for i in range(keys_per_tile):
+            tb.compute(t, 4, 4)
+            tb.read(t, base + i * 8, 8)
+            d = int(keys[t, i])
+            tb.write(t, hist_array + (t * radix + d) * 8, 8)
+        tb.barrier(t, 0, num_tiles)
+        # Phase 3: prefix — read every tile's histogram slice.
+        for p in range(num_tiles):
+            stride = max(1, line_size // 8)
+            for d in range(0, radix, stride):
+                tb.compute(t, 2, 2)
+                tb.read(t, hist_array + (p * radix + d) * 8, 8)
+        tb.barrier(t, 1, num_tiles)
+        # Phase 5: permutation — read key, write to ranked slot.
+        for i in range(keys_per_tile):
+            tb.compute(t, 6, 6)
+            tb.read(t, base + i * 8, 8)
+            tb.write(t, out_array + int(rank[t, i]) * 8, 8)
+        tb.barrier(t, 2, num_tiles)
+    trace = tb.build()
+    if max_events_per_tile is not None and trace.num_events > max_events_per_tile:
+        raise ValueError(
+            f"radix trace has {trace.num_events} events/tile > cap")
+    return trace
+
+
+GENERATORS = {
+    "compute": gen_compute,
+    "private_mem": gen_private_mem,
+    "stream": gen_stream,
+    "shared_readers": gen_shared_readers,
+    "migratory": gen_migratory,
+    "ping_pong": gen_ping_pong,
+    "barrier_compute": gen_barrier_compute,
+    "lock_contention": gen_lock_contention,
+    "radix": gen_radix,
+}

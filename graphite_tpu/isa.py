@@ -71,6 +71,10 @@ class EventOp(enum.IntEnum):
     DVFS_SET = 10    # change this tile's domain frequency
     ATOMIC = 11      # atomic read-modify-write (exclusive request + update)
     DONE = 12        # tile finished its stream
+    BARRIER_WAIT = 13  # block until all participants arrive (SimBarrier analog,
+                       # reference: common/system/sync_server.h:15-121)
+    MUTEX_LOCK = 14    # FCFS simulated mutex acquire (SimMutex analog)
+    MUTEX_UNLOCK = 15  # release; wakes earliest waiter
 
 
 class MemComponent(enum.IntEnum):

@@ -25,6 +25,19 @@ from graphite_tpu.isa import STATIC_COST_TYPES, DVFSModule
 from graphite_tpu.time_base import ns_to_ps
 
 
+def _int_or_keyword(cfg: Config, path: str, keyword: str) -> Optional[int]:
+    """Config value that is either the magic ``keyword`` (-> None) or an
+    integer; anything else is a ConfigError."""
+    raw = cfg.get_str(path).strip()
+    if raw.lower() == keyword.lower():
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ConfigError(
+            f"{path} must be {keyword!r} or an integer: {raw!r}") from None
+
+
 def _ceil_log2(x: int) -> int:
     return max(0, (x - 1).bit_length())
 
@@ -111,8 +124,8 @@ class DirectoryParams:
     def from_config(cls, cfg: Config, num_tiles: int, l2: CacheParams,
                     num_slices: int) -> "DirectoryParams":
         assoc = cfg.get_int("dram_directory/associativity")
-        entries_str = cfg.get_str("dram_directory/total_entries")
-        if entries_str == "auto":
+        total_entries = _int_or_keyword(cfg, "dram_directory/total_entries", "auto")
+        if total_entries is None:
             # Cover 2x the aggregate L2 capacity, spread over the directory
             # slices, rounded up to a power-of-2 set count (same sizing rule
             # as the reference, directory_cache.cc:249-256).
@@ -120,25 +133,11 @@ class DirectoryParams:
                              (l2.line_size * assoc * num_slices))
             sets = _ceil_pow2(sets)
             total_entries = sets * assoc
-        else:
-            try:
-                total_entries = int(entries_str)
-            except ValueError:
-                raise ConfigError(
-                    f"dram_directory/total_entries must be 'auto' or an integer: {entries_str!r}"
-                ) from None
 
-        access_str = cfg.get_str("dram_directory/access_time")
-        if access_str == "auto":
+        access = _int_or_keyword(cfg, "dram_directory/access_time", "auto")
+        if access is None:
             access = _auto_directory_access_cycles(
                 total_entries, num_tiles, cfg.get_int("dram_directory/max_hw_sharers"))
-        else:
-            try:
-                access = int(access_str)
-            except ValueError:
-                raise ConfigError(
-                    f"dram_directory/access_time must be 'auto' or an integer: {access_str!r}"
-                ) from None
 
         return cls(
             total_entries=total_entries,
@@ -187,17 +186,11 @@ class DramParams:
 
     @classmethod
     def from_config(cls, cfg: Config, num_tiles: int) -> "DramParams":
-        raw = cfg.get_str("dram/num_controllers")
-        if raw.strip().upper() == "ALL":
+        n = _int_or_keyword(cfg, "dram/num_controllers", "ALL")
+        if n is None:
             n = num_tiles
-        else:
-            try:
-                n = int(raw)
-            except ValueError:
-                raise ConfigError(
-                    f"dram/num_controllers must be 'ALL' or an integer: {raw!r}") from None
-            if n <= 0 or n > num_tiles:
-                raise ConfigError(f"dram/num_controllers out of range: {n}")
+        elif n <= 0 or n > num_tiles:
+            raise ConfigError(f"dram/num_controllers out of range: {n}")
         stride = max(1, num_tiles // n)
         return cls(
             latency_ns=cfg.get_float("dram/latency"),
@@ -349,6 +342,12 @@ class SimParams:
     @property
     def line_size(self) -> int:
         return self.l2.line_size
+
+    def __post_init__(self):
+        sizes = {self.l1i.line_size, self.l1d.line_size, self.l2.line_size}
+        if len(sizes) != 1:
+            raise ConfigError(
+                f"cache line sizes must agree across L1I/L1D/L2, got {sizes}")
 
     def module_freq_ghz(self, module: DVFSModule) -> float:
         """Initial frequency of a module from its DVFS domain."""

@@ -1,0 +1,84 @@
+"""Tests for the event schema + synthetic generators."""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.events.schema import Trace, TraceBuilder
+from graphite_tpu.events import synth
+from graphite_tpu.isa import EventOp
+
+
+def test_builder_basic_roundtrip(tmp_path):
+    tb = TraceBuilder(2)
+    tb.compute(0, 10, 10)
+    tb.read(0, 0x1000, 8)
+    tb.write(1, 0x2000, 4)
+    tr = tb.build()
+    assert tr.num_tiles == 2
+    assert tr.ops[0, 0] == EventOp.COMPUTE
+    assert tr.ops[0, 1] == EventOp.MEM_READ
+    assert tr.ops[0, 2] == EventOp.DONE
+    assert tr.ops[1, 0] == EventOp.MEM_WRITE
+    assert tr.ops[1, 1] == EventOp.DONE
+    p = tmp_path / "t.npz"
+    tr.save(str(p))
+    tr2 = Trace.load(str(p))
+    assert np.array_equal(tr.ops, tr2.ops)
+    assert np.array_equal(tr.addr, tr2.addr)
+
+
+def test_line_splitting():
+    # A 16-byte access straddling a 64-byte line boundary -> two events,
+    # mirroring Core::initiateMemoryAccess splitting (core.cc:173-245).
+    tb = TraceBuilder(1, line_size=64)
+    tb.read(0, 56, 16)
+    tr = tb.build()
+    assert tr.ops[0, 0] == EventOp.MEM_READ and tr.addr[0, 0] == 56
+    assert tr.arg[0, 0] == 8
+    assert tr.ops[0, 1] == EventOp.MEM_READ and tr.addr[0, 1] == 64
+    assert tr.arg[0, 1] == 8
+
+
+def test_done_guard():
+    tb = TraceBuilder(1)
+    tb.done(0)
+    with pytest.raises(ValueError):
+        tb.compute(0, 1, 1)
+
+
+def test_instruction_count():
+    tb = TraceBuilder(1)
+    tb.compute(0, 10, 7)
+    tb.read(0, 0x100, 8)
+    tb.branch(0, True)
+    tr = tb.build()
+    assert tr.instruction_count() == 9
+
+
+def test_generators_shapes():
+    for name, gen in synth.GENERATORS.items():
+        if name == "radix":
+            tr = gen(4, keys_per_tile=32, radix=16)
+        elif name == "ping_pong":
+            tr = gen(4, messages=4)
+        else:
+            tr = gen(4)
+        assert tr.num_tiles == 4
+        # every tile terminates
+        assert (tr.ops == EventOp.DONE).sum(axis=1).min() == 1
+
+
+def test_radix_permutation_covers_output():
+    tr = synth.gen_radix(2, keys_per_tile=64, radix=8)
+    writes = tr.addr[tr.ops == EventOp.MEM_WRITE]
+    out = writes[writes >= synth.SHARED_BASE + 0x400_0000]
+    # permutation writes hit distinct ranked slots covering 0..n-1
+    slots = np.sort((out - (synth.SHARED_BASE + 0x400_0000)) // 8)
+    assert np.array_equal(slots, np.arange(128))
+
+
+def test_pad_to():
+    tr = synth.gen_compute(2, blocks=3)
+    tr2 = tr.pad_to(100)
+    assert tr2.num_events == 100
+    assert (tr2.ops[:, -1] == EventOp.NOP).all()
