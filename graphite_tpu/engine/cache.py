@@ -1,0 +1,179 @@
+"""Batched set-associative cache arrays.
+
+The reference's generic cache (common/tile/memory_subsystem/cache/cache.{h,cc},
+cache_set.{h,cc}, cache_line_info.{h,cc}) is a per-tile C++ object probed one
+access at a time under the tile's MMU lock.  Here one cache *level* across
+ALL tiles is three arrays shaped ``[num_tiles, sets, assoc]`` (tag, coherence
+state, LRU rank) and every operation is batched over the tile axis — one
+probe call services every tile's current access.
+
+Coherence states are shared between cache levels and the directory logic
+(reference: common/tile/memory_subsystem/cache/cache_state.h and
+directory_state.h):
+  I=0 < S=1 < O=2 < E=3 < M=4 — ordered so "writable" is a comparison.
+
+Replacement: LRU rank array (0 = MRU), matching the reference's default
+(lru_replacement_policy.cc); round_robin keeps a per-set pointer and is
+selected by config.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.params import CacheParams
+
+# Coherence state codes (cache lines AND directory entries).
+I, S, O, E, M = 0, 1, 2, 3, 4
+
+
+class CacheArrays(NamedTuple):
+    """One cache level for all tiles: [T, sets, assoc] arrays."""
+
+    tags: jnp.ndarray    # int64 line address; meaningful iff state != I
+    state: jnp.ndarray   # int32 coherence state
+    lru: jnp.ndarray     # int32 LRU rank, 0 = most recently used
+    rr_ptr: jnp.ndarray  # int32 [T, sets] round-robin victim pointer
+
+
+def make_cache(num_tiles: int, params: CacheParams) -> CacheArrays:
+    shape = (num_tiles, params.num_sets, params.associativity)
+    return CacheArrays(
+        tags=jnp.zeros(shape, dtype=jnp.int64),
+        state=jnp.zeros(shape, dtype=jnp.int32),
+        lru=jnp.tile(
+            jnp.arange(params.associativity, dtype=jnp.int32),
+            (num_tiles, params.num_sets, 1)),
+        rr_ptr=jnp.zeros(shape[:2], dtype=jnp.int32),
+    )
+
+
+def set_index(line: jnp.ndarray, num_sets: int) -> jnp.ndarray:
+    """Default modulo hash over the line address (reference:
+    cache_hash_fn.h 'mod' default)."""
+    return (line % num_sets).astype(jnp.int32)
+
+
+class ProbeResult(NamedTuple):
+    hit: jnp.ndarray       # [T] bool
+    way: jnp.ndarray       # [T] int32 (valid iff hit)
+    state: jnp.ndarray     # [T] int32 (I when miss)
+    set_idx: jnp.ndarray   # [T] int32
+
+
+def probe(cache: CacheArrays, line: jnp.ndarray, num_sets: int) -> ProbeResult:
+    """Look up ``line`` ([T] int64, one per tile) in each tile's cache."""
+    T = cache.tags.shape[0]
+    sidx = set_index(line, num_sets)
+    rows = jnp.arange(T)
+    tags_set = cache.tags[rows, sidx]      # [T, A]
+    state_set = cache.state[rows, sidx]    # [T, A]
+    match = (tags_set == line[:, None]) & (state_set != I)
+    hit = match.any(axis=1)
+    way = jnp.argmax(match, axis=1).astype(jnp.int32)
+    st = jnp.where(hit, jnp.take_along_axis(
+        state_set, way[:, None], axis=1)[:, 0], I)
+    return ProbeResult(hit=hit, way=way, state=st, set_idx=sidx)
+
+
+def touch(cache: CacheArrays, set_idx: jnp.ndarray, way: jnp.ndarray,
+          active: jnp.ndarray) -> CacheArrays:
+    """Promote (set_idx, way) to MRU for tiles where ``active``."""
+    T = cache.tags.shape[0]
+    rows = jnp.arange(T)
+    ranks = cache.lru[rows, set_idx]                       # [T, A]
+    r_w = jnp.take_along_axis(ranks, way[:, None], axis=1)  # [T, 1]
+    promoted = jnp.where(
+        jnp.arange(ranks.shape[1])[None, :] == way[:, None],
+        0, ranks + (ranks < r_w))
+    new = jnp.where(active[:, None], promoted, ranks)
+    return cache._replace(lru=cache.lru.at[rows, set_idx].set(new))
+
+
+def set_state(cache: CacheArrays, set_idx: jnp.ndarray, way: jnp.ndarray,
+              new_state: jnp.ndarray, active: jnp.ndarray) -> CacheArrays:
+    """State transition on an existing line (masked scatter)."""
+    T = cache.tags.shape[0]
+    rows = jnp.arange(T)
+    way_eff = jnp.where(active, way, cache.tags.shape[2]).astype(jnp.int32)
+    return cache._replace(
+        state=cache.state.at[rows, set_idx, way_eff].set(
+            new_state, mode="drop"))
+
+
+class FillResult(NamedTuple):
+    cache: CacheArrays
+    way: jnp.ndarray           # [T] chosen way
+    victim_tag: jnp.ndarray    # [T] int64 evicted line (valid iff victim_state != I)
+    victim_state: jnp.ndarray  # [T] int32 state of the evicted line
+
+
+def fill(cache: CacheArrays, line: jnp.ndarray, new_state: jnp.ndarray,
+         active: jnp.ndarray, num_sets: int,
+         replacement: str = "lru") -> FillResult:
+    """Allocate ``line`` in its set, evicting invalid-first then by policy
+    (reference: cache_set.cc replace() + lru_replacement_policy.cc).
+    Returns the victim so the caller can model writeback/coherence."""
+    T, _, A = cache.tags.shape
+    rows = jnp.arange(T)
+    sidx = set_index(line, num_sets)
+    state_set = cache.state[rows, sidx]
+    tags_set = cache.tags[rows, sidx]
+    invalid = state_set == I
+    has_invalid = invalid.any(axis=1)
+    first_invalid = jnp.argmax(invalid, axis=1)
+    if replacement == "round_robin":
+        ptr = cache.rr_ptr[rows, sidx]
+        policy_way = ptr % A
+        cache = cache._replace(
+            rr_ptr=cache.rr_ptr.at[rows, sidx].set(
+                jnp.where(active, (ptr + 1) % A, ptr)))
+    else:
+        policy_way = jnp.argmax(cache.lru[rows, sidx], axis=1)
+    way = jnp.where(has_invalid, first_invalid, policy_way).astype(jnp.int32)
+
+    victim_tag = jnp.take_along_axis(tags_set, way[:, None], axis=1)[:, 0]
+    victim_state = jnp.where(
+        active,
+        jnp.take_along_axis(state_set, way[:, None], axis=1)[:, 0], I)
+
+    way_eff = jnp.where(active, way, A).astype(jnp.int32)
+    cache = cache._replace(
+        tags=cache.tags.at[rows, sidx, way_eff].set(line, mode="drop"),
+        state=cache.state.at[rows, sidx, way_eff].set(new_state, mode="drop"),
+    )
+    cache = touch(cache, sidx, way, active)
+    return FillResult(cache=cache, way=way, victim_tag=victim_tag,
+                      victim_state=victim_state)
+
+
+def invalidate_lines(cache: CacheArrays, tile_lines: jnp.ndarray,
+                     valid: jnp.ndarray, num_sets: int,
+                     downgrade_to: int = I) -> Tuple[CacheArrays, jnp.ndarray]:
+    """Coherence-driven state change of arbitrary (tile, line) pairs.
+
+    ``tile_lines``: [K, 2] int64 rows of (tile, line); ``valid``: [K] bool.
+    Used for directory-initiated INV_REQ / WB_REQ delivery (reference:
+    l1_cache_cntlr / l2_cache_cntlr handleMsgFromDramDirectory paths).
+    Returns (cache, was_dirty [K]) — was_dirty reports lines found in M/O
+    (so the caller can model the writeback data message).
+    """
+    tiles = tile_lines[:, 0].astype(jnp.int32)
+    lines = tile_lines[:, 1]
+    sidx = set_index(lines, num_sets)
+    tags_set = cache.tags[tiles, sidx]    # [K, A]
+    state_set = cache.state[tiles, sidx]  # [K, A]
+    match = (tags_set == lines[:, None]) & (state_set != I) & valid[:, None]
+    way = jnp.argmax(match, axis=1).astype(jnp.int32)
+    found = match.any(axis=1)
+    st = jnp.take_along_axis(state_set, way[:, None], axis=1)[:, 0]
+    was_dirty = found & ((st == M) | (st == O))
+    way_eff = jnp.where(found, way, cache.tags.shape[2]).astype(jnp.int32)
+    new_state = jnp.where(
+        (downgrade_to != I) & (st >= S), downgrade_to, I).astype(jnp.int32)
+    cache = cache._replace(
+        state=cache.state.at[tiles, sidx, way_eff].set(new_state, mode="drop"))
+    return cache, was_dirty

@@ -1,0 +1,301 @@
+"""Local (intra-tile) event processing — the per-quantum core kernel.
+
+This replaces the reference's app-thread hot path — the injected analysis
+calls that queue each instruction into the core model and synchronously
+probe the private cache hierarchy (reference: pin/instruction_modeling.cc:13-21
+-> CoreModel::queueInstruction/iterate core_model.cc:282-299 ->
+SimpleCoreModel::handleInstruction simple_core_model.cc:37-97 ->
+Core::initiateMemoryAccess core.cc:139-266 -> L1/L2 controllers).
+
+Execution shape: a ``lax.scan`` over event slots; each slot retires at most
+one event on every tile simultaneously (all-tile SIMD step).  Purely local
+outcomes (compute blocks, branches, L1/L2 hits, sends, unlocks, stalls)
+complete in-slot; anything needing another tile — an L2 miss (directory
+coherence), a blocking receive, a sync object — parks the tile with a
+*pending request* that the cross-tile resolve phase (engine/resolve.py)
+completes, mirroring how the reference's app thread blocks in
+MemoryManager::waitForSimThread (memory_manager.h:40-44) or
+SyncClient::netRecv.
+
+Timing semantics mirror SimpleCoreModel: every instruction pays its static
+cost plus an L1I fetch access; memory operands add the memory-system
+latency; branches pay 1 cycle when predicted, the mispredict penalty
+otherwise (one-bit predictor, one_bit_branch_predictor.cc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.engine import noc
+from graphite_tpu.engine.state import (
+    PEND_BARRIER, PEND_EX_REQ, PEND_IFETCH, PEND_MUTEX, PEND_NONE,
+    PEND_RECV, PEND_SH_REQ, SimState, TraceArrays)
+from graphite_tpu.events.schema import ICACHE_BYTES_PER_INSTRUCTION
+from graphite_tpu.isa import DVFSModule, EventOp
+from graphite_tpu.params import SimParams
+
+I, S, M = cachemod.I, cachemod.S, cachemod.M
+
+
+def _lat(cycles, period_ps):
+    """cycles (int/array) at a per-tile clock period -> int64 ps."""
+    return jnp.int64(jnp.round(cycles * period_ps))
+
+
+def _period(state: SimState, module: DVFSModule):
+    return 1000.0 / state.freq_ghz[:, int(module)]
+
+
+def mcp_tile(params: SimParams) -> int:
+    """Sync/control server tile — the highest tile, as the reference places
+    the MCP (common/misc/config.h:88)."""
+    return params.num_tiles - 1
+
+
+def local_advance(params: SimParams, state: SimState,
+                  trace: TraceArrays) -> SimState:
+    """Advance every non-blocked tile through up to
+    ``params.max_events_per_quantum`` events, stopping each tile at the
+    quantum boundary, stream end, or its first remote-blocking event."""
+
+    T = params.num_tiles
+    N = trace.ops.shape[1]
+    line_bits = params.line_size.bit_length() - 1
+    rows = jnp.arange(T)
+    chan_depth = state.ch_time.shape[2]
+    num_locks = state.lock_holder.shape[0]
+    num_bars = state.bar_count.shape[0]
+    mcp = mcp_tile(params)
+
+    def slot(st: SimState, _):
+        c = st.counters
+        active = (~st.done) & (st.pend_kind == PEND_NONE) \
+            & (st.clock < st.boundary) & (st.cursor < N)
+        cur = jnp.minimum(st.cursor, N - 1)
+        op = jnp.where(active, trace.ops[rows, cur], EventOp.NOP)
+        addr = trace.addr[rows, cur]
+        arg = trace.arg[rows, cur]
+        arg2 = trace.arg2[rows, cur]
+
+        # Per-tile clock periods (DVFS-aware), ps per cycle.
+        p_core = _period(st, DVFSModule.CORE)
+        p_l1i = _period(st, DVFSModule.L1_ICACHE)
+        p_l1d = _period(st, DVFSModule.L1_DCACHE)
+        p_l2 = _period(st, DVFSModule.L2_CACHE)
+        p_nu = _period(st, DVFSModule.NETWORK_USER)
+
+        l1i_ps = _lat(params.l1i.access_cycles, p_l1i)
+        l1d_ps = _lat(params.l1d.access_cycles, p_l1d)
+        l2_ps = _lat(params.l2.access_cycles, p_l2)
+        l2_tag_ps = _lat(params.l2.tags_access_cycles, p_l2)
+        cycle_ps = _lat(1, p_core)
+
+        line = addr >> line_bits
+        pI = cachemod.probe(st.l1i, line, params.l1i.num_sets)
+        pD = cachemod.probe(st.l1d, line, params.l1d.num_sets)
+        pL2 = cachemod.probe(st.l2, line, params.l2.num_sets)
+
+        # ---------------------------------------------------- COMPUTE blocks
+        is_comp = op == EventOp.COMPUTE
+        icount_ev = jnp.maximum(arg2, 0).astype(jnp.int64)
+        n_lines = jnp.maximum(
+            (icount_ev * ICACHE_BYTES_PER_INSTRUCTION + params.line_size - 1)
+            // params.line_size, 1)
+        cost_ps = _lat(jnp.maximum(arg, 0), p_core)
+        # i-fetch: every instruction pays one L1I access (SimpleCoreModel
+        # modelICache per instruction); on an L1I miss the first line's L2
+        # latency is charged for each line of the block (sequential-stream
+        # approximation — only the first line's tags are actually filled).
+        fetch_ps = icount_ev * l1i_ps
+        comp_l2path = is_comp & ~pI.hit & pL2.hit
+        comp_block = is_comp & ~pI.hit & ~pL2.hit
+        comp_ok = is_comp & ~comp_block
+        dt_comp = cost_ps + fetch_ps + jnp.where(~pI.hit, n_lines * l2_ps, 0)
+
+        # ------------------------------------------------------- BRANCH
+        is_br = op == EventOp.BRANCH
+        bidx = (addr % params.core.bp_size).astype(jnp.int32)
+        pred = st.bp_table[rows, bidx]
+        taken = arg != 0
+        correct = pred == taken
+        dt_br = jnp.where(correct, cycle_ps,
+                          _lat(params.core.bp_mispredict_penalty, p_core)) + l1i_ps
+        bidx_eff = jnp.where(is_br, bidx, params.core.bp_size).astype(jnp.int32)
+        bp_table = st.bp_table.at[rows, bidx_eff].set(taken, mode="drop")
+
+        # ------------------------------------------------- MEMORY OPERANDS
+        is_rd = op == EventOp.MEM_READ
+        is_at = op == EventOp.ATOMIC
+        is_wr = (op == EventOp.MEM_WRITE) | is_at
+        is_mem = is_rd | is_wr
+        l1_ok = pD.hit & (is_rd | (pD.state == M))
+        l2_ok = pL2.hit & (is_rd | (pL2.state == M))
+        mem_l1 = is_mem & l1_ok
+        mem_l2 = is_mem & ~l1_ok & l2_ok
+        mem_rem = is_mem & ~l1_ok & ~l2_ok
+        at_extra = jnp.where(is_at, cycle_ps, 0)
+        dt_mem_l1 = l1d_ps + at_extra
+        dt_mem_l2 = l1d_ps + l2_ps + at_extra
+
+        # --------------------------------------------- USER NETWORK (CAPI)
+        is_send = op == EventOp.SEND
+        is_recv = op == EventOp.RECV
+        dst = jnp.clip(arg2, 0, T - 1)
+        send_net_ps = noc.unicast_ps(
+            params.net_user, rows, dst, jnp.maximum(arg, 0), p_nu,
+            params.mesh_width)
+        arrival = st.clock + cycle_ps + send_net_ps
+        slot_idx = st.ch_sent[rows, dst] % chan_depth
+        src_eff = jnp.where(is_send, rows, T).astype(jnp.int32)
+        ch_time = st.ch_time.at[src_eff, dst, slot_idx].set(
+            arrival, mode="drop")
+        ch_sent = st.ch_sent.at[src_eff, dst].add(1, mode="drop")
+        dt_send = cycle_ps
+
+        # ------------------------------------------------------ SYNC OPS
+        is_bar = op == EventOp.BARRIER_WAIT
+        is_lock = op == EventOp.MUTEX_LOCK
+        is_unlock = op == EventOp.MUTEX_UNLOCK
+        to_mcp_ps = noc.unicast_ps(
+            params.net_user, rows, jnp.full((T,), mcp), 8, p_nu,
+            params.mesh_width)
+        # barrier arrival bookkeeping (server side of SimBarrier)
+        bar_id = jnp.clip(arg, 0, num_bars - 1)
+        bar_eff = jnp.where(is_bar, bar_id, num_bars).astype(jnp.int32)
+        bar_count = st.bar_count.at[bar_eff].add(1, mode="drop")
+        bar_time = st.bar_time.at[bar_eff].max(
+            st.clock + to_mcp_ps, mode="drop")
+        # unlock: release the mutex at MCP-arrival time; requester pays the
+        # round trip (SyncClient blocks on the ack, sync_client.h:10-30)
+        lock_id = jnp.clip(arg, 0, num_locks - 1)
+        ul_eff = jnp.where(is_unlock, lock_id, num_locks).astype(jnp.int32)
+        lock_holder = st.lock_holder.at[ul_eff].set(0, mode="drop")
+        lock_free_at = st.lock_free_at.at[ul_eff].max(
+            st.clock + to_mcp_ps + cycle_ps, mode="drop")
+        dt_unlock = 2 * to_mcp_ps + 2 * cycle_ps
+
+        # ------------------------------------------------ SIMPLE/DYNAMIC OPS
+        is_stall = op == EventOp.STALL
+        is_sync = op == EventOp.SYNC
+        is_spawn = op == EventOp.SPAWN
+        is_dvfs = op == EventOp.DVFS_SET
+        is_done = op == EventOp.DONE
+        dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
+        dt_dvfs = _lat(params.dvfs_sync_delay_cycles, p_core)
+        mod_eff = jnp.where(is_dvfs,
+                            jnp.clip(arg, 0, state.freq_ghz.shape[1] - 1),
+                            state.freq_ghz.shape[1]).astype(jnp.int32)
+        freq_ghz = st.freq_ghz.at[rows, mod_eff].set(
+            jnp.maximum(arg2, 1) / 1000.0, mode="drop")
+
+        # ------------------------------------------------------ combine dt
+        dt = jnp.zeros(T, dtype=jnp.int64)
+        dt = jnp.where(comp_ok, dt_comp, dt)
+        dt = jnp.where(is_br, dt_br, dt)
+        dt = jnp.where(mem_l1, dt_mem_l1, dt)
+        dt = jnp.where(mem_l2, dt_mem_l2, dt)
+        dt = jnp.where(is_send, dt_send, dt)
+        dt = jnp.where(is_unlock, dt_unlock, dt)
+        dt = jnp.where(is_spawn, dt_spawn, dt)
+        dt = jnp.where(is_dvfs, dt_dvfs, dt)
+
+        new_clock = st.clock + dt
+        new_clock = jnp.where(
+            is_stall, jnp.maximum(st.clock, addr), new_clock)
+        new_clock = jnp.where(
+            is_sync,
+            jnp.maximum(st.clock, addr) + _lat(jnp.maximum(arg, 0), p_core),
+            new_clock)
+
+        # ------------------------------------------------- blocking events
+        blocked = comp_block | mem_rem | is_recv | is_bar | is_lock
+        kind = jnp.where(comp_block, PEND_IFETCH, PEND_NONE)
+        kind = jnp.where(mem_rem & is_rd, PEND_SH_REQ, kind)
+        kind = jnp.where(mem_rem & is_wr, PEND_EX_REQ, kind)
+        kind = jnp.where(is_recv, PEND_RECV, kind)
+        kind = jnp.where(is_bar, PEND_BARRIER, kind)
+        kind = jnp.where(is_lock, PEND_MUTEX, kind)
+        pend_kind = jnp.where(blocked, kind, st.pend_kind)
+        pend_addr = jnp.where(is_bar | is_lock, jnp.int64(arg),
+                              jnp.where(blocked, addr, st.pend_addr))
+        issue = st.clock + jnp.where(
+            comp_block, l1i_ps + l2_tag_ps,
+            jnp.where(mem_rem, l1d_ps + l2_tag_ps, cycle_ps))
+        pend_issue = jnp.where(blocked, issue, st.pend_issue)
+        pend_aux = jnp.where(blocked, arg2, st.pend_aux)
+
+        # ------------------------------------------------- cache updates
+        l1i = cachemod.touch(st.l1i, pI.set_idx, pI.way, is_comp & pI.hit)
+        fI = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
+                           comp_l2path, params.l1i.num_sets,
+                           params.l1i.replacement)
+        l1i = fI.cache
+        l2 = cachemod.touch(st.l2, pL2.set_idx, pL2.way,
+                            (comp_l2path | mem_l2))
+
+        l1d = cachemod.touch(st.l1d, pD.set_idx, pD.way, mem_l1)
+        # L1D fill from a local L2 hit; dirty L1 victims fold into the
+        # (inclusive) L2 copy, which already holds M state — timing-only.
+        fD = cachemod.fill(l1d, line,
+                           jnp.where(is_wr, M, S).astype(jnp.int32),
+                           mem_l2, params.l1d.num_sets,
+                           params.l1d.replacement)
+        l1d = fD.cache
+
+        # ------------------------------------------------------- counters
+        def add(x, mask, val=1):
+            return x + jnp.where(mask, jnp.int64(val), 0)
+
+        c = c._replace(
+            icount=c.icount
+            + jnp.where(is_comp, icount_ev, 0)
+            + jnp.where((is_mem & (arg2 == 0)) | is_br, 1, 0),
+            l1i_access=c.l1i_access + jnp.where(comp_ok, icount_ev, 0)
+            + jnp.where(is_br, 1, 0),
+            l1i_miss=c.l1i_miss + jnp.where(is_comp & ~pI.hit & active,
+                                            n_lines, 0),
+            l1d_read=add(c.l1d_read, is_rd),
+            l1d_read_miss=add(c.l1d_read_miss, is_rd & ~l1_ok),
+            l1d_write=add(c.l1d_write, is_wr),
+            l1d_write_miss=add(c.l1d_write_miss, is_wr & ~l1_ok),
+            l2_access=add(c.l2_access, mem_l2 | mem_rem | comp_l2path
+                          | comp_block),
+            l2_miss=add(c.l2_miss, mem_rem | comp_block),
+            branches=add(c.branches, is_br),
+            mispredicts=add(c.mispredicts, is_br & ~correct),
+            net_user_pkts=add(c.net_user_pkts, is_send),
+            net_user_flits=c.net_user_flits + jnp.where(
+                is_send,
+                noc.num_flits(jnp.maximum(arg, 0),
+                              params.net_user.flit_width_bits), 0),
+            sends=add(c.sends, is_send),
+            barriers=add(c.barriers, is_bar),
+        )
+
+        st = st._replace(
+            clock=new_clock,
+            cursor=st.cursor + jnp.where(active & ~blocked, 1, 0),
+            done=st.done | is_done,
+            pend_kind=pend_kind,
+            pend_addr=pend_addr,
+            pend_issue=pend_issue,
+            pend_aux=pend_aux,
+            bp_table=bp_table,
+            l1i=l1i, l1d=l1d, l2=l2,
+            freq_ghz=freq_ghz,
+            lock_holder=lock_holder,
+            lock_free_at=lock_free_at,
+            bar_count=bar_count,
+            bar_time=bar_time,
+            ch_sent=ch_sent,
+            ch_time=ch_time,
+            counters=c,
+        )
+        return st, None
+
+    state, _ = jax.lax.scan(slot, state, None,
+                            length=params.max_events_per_quantum)
+    return state

@@ -1,0 +1,193 @@
+"""Simulation state: one pytree of [num_tiles, ...] arrays.
+
+This is the TPU build's replacement for the reference's object graph of
+per-tile Tile/Core/MemoryManager/NetworkModel instances plus the MCP-side
+server state (reference: common/tile/tile.cc:15-37 builds the per-tile
+objects; common/system/sync_server.h holds mutex/cond/barrier state on the
+MCP tile).  Everything mutable during simulation lives here; everything
+static (geometry, latencies, model choices) lives in SimParams and is baked
+into the compiled step.
+
+The tile axis (leading dimension) is the sharding axis: under a device
+mesh, arrays here are sharded over it, turning the reference's multi-process
+socket distribution (common/transport/socktransport.cc) into XLA collectives.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.events.schema import Trace
+from graphite_tpu.isa import DVFSModule, EventOp
+from graphite_tpu.params import SimParams
+
+# Pending-request kinds (a tile blocks on at most one at a time — in-order
+# cores block inside Core::initiateMemoryAccess, reference core.cc:139).
+PEND_NONE = 0
+PEND_SH_REQ = 1     # read miss -> directory SH_REQ (shmem_msg.h:14-28)
+PEND_EX_REQ = 2     # write/atomic miss or S-upgrade -> EX_REQ
+PEND_IFETCH = 3     # instruction-fetch L2 miss (read-only SH_REQ)
+PEND_RECV = 4       # blocking user-network receive (CAPI)
+PEND_BARRIER = 5    # SimBarrier wait
+PEND_MUTEX = 6      # SimMutex acquire
+
+NUM_DVFS_MODULES = len(DVFSModule)
+
+
+class Counters(NamedTuple):
+    """Per-tile event counters ([T] int64 each) — the data behind the
+    end-of-run summary (reference: each component's outputSummary(),
+    e.g. cache counters in cache.cc, network_model.h:73)."""
+
+    icount: jnp.ndarray
+    l1i_access: jnp.ndarray
+    l1i_miss: jnp.ndarray
+    l1d_read: jnp.ndarray
+    l1d_read_miss: jnp.ndarray
+    l1d_write: jnp.ndarray
+    l1d_write_miss: jnp.ndarray
+    l2_access: jnp.ndarray
+    l2_miss: jnp.ndarray
+    branches: jnp.ndarray
+    mispredicts: jnp.ndarray
+    dir_sh_req: jnp.ndarray      # SH_REQ served at this tile's directory slice
+    dir_ex_req: jnp.ndarray
+    dir_invalidations: jnp.ndarray   # INV_REQ messages sent from this slice
+    dir_writebacks: jnp.ndarray      # WB/FLUSH data returns to this slice
+    dir_evictions: jnp.ndarray       # directory-cache entry evictions
+    dram_reads: jnp.ndarray          # at this tile's memory controller
+    dram_writes: jnp.ndarray
+    net_mem_pkts: jnp.ndarray        # memory-network packets this tile sent
+    net_mem_flits: jnp.ndarray
+    net_user_pkts: jnp.ndarray
+    net_user_flits: jnp.ndarray
+    sends: jnp.ndarray
+    recvs: jnp.ndarray
+    barriers: jnp.ndarray
+    mutex_acquires: jnp.ndarray
+    mem_stall_ps: jnp.ndarray        # time blocked on remote memory
+    sync_stall_ps: jnp.ndarray       # time blocked on sync/recv
+
+
+def make_counters(num_tiles: int) -> Counters:
+    z = lambda: jnp.zeros(num_tiles, dtype=jnp.int64)
+    return Counters(**{f: z() for f in Counters._fields})
+
+
+class TraceArrays(NamedTuple):
+    """Device-resident trace (see events/schema.py for field semantics)."""
+
+    ops: jnp.ndarray    # [T, N] int32
+    addr: jnp.ndarray   # [T, N] int64
+    arg: jnp.ndarray    # [T, N] int32
+    arg2: jnp.ndarray   # [T, N] int32
+
+    @classmethod
+    def from_trace(cls, trace: Trace) -> "TraceArrays":
+        return cls(
+            ops=jnp.asarray(trace.ops),
+            addr=jnp.asarray(trace.addr),
+            arg=jnp.asarray(trace.arg),
+            arg2=jnp.asarray(trace.arg2),
+        )
+
+
+class SimState(NamedTuple):
+    """All mutable simulation state (a single jit-traversable pytree)."""
+
+    # -- core (reference: CoreModel per-tile time/queues, core_model.h:19-146)
+    clock: jnp.ndarray        # [T] int64 ps — the per-tile target clock
+    cursor: jnp.ndarray       # [T] int32 — next trace event index
+    done: jnp.ndarray         # [T] bool
+    boundary: jnp.ndarray     # [] int64 ps — current lax-barrier quantum end
+
+    # -- pending remote operation (at most one per tile)
+    pend_kind: jnp.ndarray    # [T] int32 PEND_*
+    pend_addr: jnp.ndarray    # [T] int64 byte address / object id
+    pend_issue: jnp.ndarray   # [T] int64 ps when the request left the tile
+    pend_aux: jnp.ndarray     # [T] int32 (recv src / barrier participants)
+
+    # -- branch predictor (reference: one_bit_branch_predictor.cc)
+    bp_table: jnp.ndarray     # [T, bp_size] bool — last outcome per slot
+
+    # -- caches (private L1I/L1D/L2 per tile)
+    l1i: cachemod.CacheArrays
+    l1d: cachemod.CacheArrays
+    l2: cachemod.CacheArrays
+
+    # -- DVFS module frequencies (reference: dvfs_manager.h:19-88)
+    freq_ghz: jnp.ndarray     # [T, NUM_DVFS_MODULES] float64
+
+    # -- directory slices (home-tile-indexed; reference: directory_cache.cc)
+    dir_tags: jnp.ndarray     # [T, dsets, dassoc] int64 line
+    dir_state: jnp.ndarray    # [T, dsets, dassoc] int32 (I/S/M dir states)
+    dir_owner: jnp.ndarray    # [T, dsets, dassoc] int32 owner tile (M/O state)
+    dir_sharers: jnp.ndarray  # [T, dsets, dassoc, W] int64 sharer bitmap words
+    dir_lru: jnp.ndarray      # [T, dsets, dassoc] int32
+
+    # -- memory controllers (reference: dram_cntlr.h + dram_perf_model.h)
+    dram_free_at: jnp.ndarray  # [T] int64 — FCFS queue-model horizon
+
+    # -- sync objects, global (reference: sync_server.h SimMutex/SimBarrier)
+    lock_holder: jnp.ndarray   # [NL] int32 holder tile + 1, 0 = free
+    lock_free_at: jnp.ndarray  # [NL] int64 time the lock was/will be released
+    bar_count: jnp.ndarray     # [NB] int32 arrivals this generation
+    bar_time: jnp.ndarray      # [NB] int64 max arrival time this generation
+
+    # -- user-network channels (CAPI; reference: common/user/capi.cc)
+    ch_sent: jnp.ndarray       # [T, T] int32 messages sent src->dst
+    ch_recvd: jnp.ndarray      # [T, T] int32 messages consumed
+    ch_time: jnp.ndarray       # [T, T, D] int64 arrival-time ring buffer
+
+    counters: Counters
+
+
+def init_freq(params: SimParams) -> np.ndarray:
+    f = np.zeros((params.num_tiles, NUM_DVFS_MODULES), dtype=np.float64)
+    for m in DVFSModule:
+        f[:, int(m)] = params.module_freq_ghz(m)
+    return f
+
+
+def make_state(params: SimParams,
+               max_mutexes: int = 64,
+               max_barriers: int = 16,
+               channel_depth: int = 16) -> SimState:
+    T = params.num_tiles
+    d_shape = (T, params.directory.num_sets, params.directory.associativity)
+    W = (T + 63) // 64  # sharer bitmap words (full_map)
+    return SimState(
+        clock=jnp.zeros(T, dtype=jnp.int64),
+        cursor=jnp.zeros(T, dtype=jnp.int32),
+        done=jnp.zeros(T, dtype=bool),
+        boundary=jnp.asarray(params.quantum_ps, dtype=jnp.int64),
+        pend_kind=jnp.zeros(T, dtype=jnp.int32),
+        pend_addr=jnp.zeros(T, dtype=jnp.int64),
+        pend_issue=jnp.zeros(T, dtype=jnp.int64),
+        pend_aux=jnp.zeros(T, dtype=jnp.int32),
+        bp_table=jnp.zeros((T, params.core.bp_size), dtype=bool),
+        l1i=cachemod.make_cache(T, params.l1i),
+        l1d=cachemod.make_cache(T, params.l1d),
+        l2=cachemod.make_cache(T, params.l2),
+        freq_ghz=jnp.asarray(init_freq(params)),
+        dir_tags=jnp.zeros(d_shape, dtype=jnp.int64),
+        dir_state=jnp.zeros(d_shape, dtype=jnp.int32),
+        dir_owner=jnp.full(d_shape, -1, dtype=jnp.int32),
+        dir_sharers=jnp.zeros(d_shape + (W,), dtype=jnp.int64),
+        dir_lru=jnp.tile(
+            jnp.arange(params.directory.associativity, dtype=jnp.int32),
+            d_shape[:2] + (1,)),
+        dram_free_at=jnp.zeros(T, dtype=jnp.int64),
+        lock_holder=jnp.zeros(max_mutexes, dtype=jnp.int32),
+        lock_free_at=jnp.zeros(max_mutexes, dtype=jnp.int64),
+        bar_count=jnp.zeros(max_barriers, dtype=jnp.int32),
+        bar_time=jnp.zeros(max_barriers, dtype=jnp.int64),
+        ch_sent=jnp.zeros((T, T), dtype=jnp.int32),
+        ch_recvd=jnp.zeros((T, T), dtype=jnp.int32),
+        ch_time=jnp.zeros((T, T, channel_depth), dtype=jnp.int64),
+        counters=make_counters(T),
+    )

@@ -1,0 +1,51 @@
+"""Test/debug helpers for the engine (the headless 'simulator-as-library'
+usage the reference's unit tests rely on, reference:
+tests/unit/shared_mem_basic/shared_mem_basic.cc:16-44)."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax.numpy as jnp
+
+from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.engine.state import SimState
+from graphite_tpu.params import CacheParams
+
+
+def warm_cache(cache: cachemod.CacheArrays, cp: CacheParams, tile: int,
+               lines: Iterable[int],
+               state_val: int = cachemod.S) -> cachemod.CacheArrays:
+    """Pre-install lines into one tile's cache (eager, host-side; for tests
+    that want warm-hit timing without modeling the cold misses)."""
+    for line in lines:
+        sidx = int(line) % cp.num_sets
+        # find a free way (or overwrite way 0)
+        ways = cache.state[tile, sidx]
+        free = int(jnp.argmax(ways == cachemod.I)) \
+            if bool((ways == cachemod.I).any()) else 0
+        cache = cache._replace(
+            tags=cache.tags.at[tile, sidx, free].set(int(line)),
+            state=cache.state.at[tile, sidx, free].set(state_val),
+        )
+    return cache
+
+
+def warm_icache_for_trace(state: SimState, params, trace) -> SimState:
+    """Install every COMPUTE/BRANCH line of the trace into L1I (all tiles)."""
+    import numpy as np
+    from graphite_tpu.isa import EventOp
+    line_bits = params.line_size.bit_length() - 1
+    ops = np.asarray(trace.ops)
+    addr = np.asarray(trace.addr)
+    arg2 = np.asarray(trace.arg2)
+    l1i = state.l1i
+    for t in range(params.num_tiles):
+        lines = set()
+        sel = (ops[t] == EventOp.COMPUTE) | (ops[t] == EventOp.BRANCH)
+        for a, n in zip(addr[t][sel], arg2[t][sel]):
+            start = int(a) >> line_bits
+            end = int(a + max(int(n), 1) * 4) >> line_bits
+            lines.update(range(start, end + 1))
+        l1i = warm_cache(l1i, params.l1i, t, lines)
+    return state._replace(l1i=l1i)
