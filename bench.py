@@ -1,0 +1,70 @@
+"""Round benchmark: simulated MIPS on the SPLASH-2 radix config.
+
+Runs the BASELINE.md config-1 workload — radix sort, 64 tiles,
+carbon_sim.cfg defaults (simple in-order cores, private L1/L2 + full-map
+MSI directory, emesh NoC, lax_barrier @ 1000 ns) — on whatever accelerator
+jax selects, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline: ratio against 20 simulated MIPS — a deliberately generous
+stand-in for 64-host-thread Graphite on this workload until the reference
+is measured in-tree (the HPCA 2010 paper reports low-single-digit MIPS per
+host core; see BASELINE.md).  The compile time of the fused step is
+excluded (one throwaway warm-up run), matching how the reference's numbers
+exclude Pin instrumentation warm-up.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_MIPS = 20.0
+NUM_TILES = 64
+KEYS_PER_TILE = 2048
+
+
+def main() -> int:
+    from graphite_tpu.config import load_config
+    from graphite_tpu.engine.sim import Simulator
+    from graphite_tpu.events import synth
+    from graphite_tpu.params import SimParams
+
+    cfg = load_config()
+    cfg.set("general/total_cores", NUM_TILES)
+    params = SimParams.from_config(cfg)
+    trace = synth.gen_radix(NUM_TILES, keys_per_tile=KEYS_PER_TILE,
+                            radix=256)
+
+    # Warm-up: compile the megastep (a few steps on a fresh state).
+    warm = Simulator(params, trace)
+    warm.run(max_steps=2)
+
+    sim = Simulator(params, trace)
+    t0 = time.perf_counter()
+    summary = sim.run()
+    host_s = time.perf_counter() - t0
+
+    instrs = summary.total_instructions
+    mips = instrs / host_s / 1e6
+    print(json.dumps({
+        "metric": "simulated_mips_radix64",
+        "value": round(mips, 3),
+        "unit": "MIPS",
+        "vs_baseline": round(mips / BASELINE_MIPS, 3),
+        "detail": {
+            "total_instructions": instrs,
+            "host_seconds": round(host_s, 3),
+            "completion_time_ns": summary.to_dict()["completion_time_ns"],
+            "device_steps": sim.steps,
+            "num_tiles": NUM_TILES,
+            "all_done": summary.to_dict()["all_done"],
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,10 +47,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.command == "run":
-        try:
-            from graphite_tpu.engine.driver import run_simulation_from_trace
-        except ImportError as e:  # engine lands in a later milestone of this build
-            raise SystemExit(f"simulation engine unavailable: {e}")
+        from graphite_tpu.engine.sim import run_simulation_from_trace
 
         summary = run_simulation_from_trace(cfg, args.trace)
         text = summary.render()

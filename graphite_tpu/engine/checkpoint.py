@@ -1,0 +1,75 @@
+"""Simulation checkpoint/resume.
+
+The reference has none — a crashed process kills the whole distributed run
+(SURVEY.md section 5.3/5.4; the closest mechanisms are the model
+enable/disable region controls, reference simulator.cc:287-301).  Because
+graphite_tpu's entire mutable state is one pytree of arrays
+(engine/state.py), checkpointing is a flatten + save: any simulation can be
+stopped, stored, moved across hosts/device counts, and resumed
+bit-identically (resume is deterministic — the engine has no RNG and no
+host-order dependence).
+
+Format: a single .npz whose keys are the flattened pytree paths, plus
+engine metadata (steps, schema version).  Orbax-style async/sharded
+checkpointing can layer on the same pytree for multi-host runs.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from graphite_tpu.engine.state import SimState, make_state
+from graphite_tpu.params import SimParams
+
+_SCHEMA_VERSION = 1
+
+
+def _flatten_with_paths(state: SimState):
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    out = {}
+    for path, leaf in leaves:
+        key = "/".join(
+            p.name if hasattr(p, "name") else str(getattr(p, "idx", p))
+            for p in path)
+        out[key] = np.asarray(leaf)
+    return out, treedef
+
+
+def save_checkpoint(path: str, state: SimState, steps: int = 0) -> None:
+    arrays, _ = _flatten_with_paths(state)
+    arrays["__meta_steps"] = np.int64(steps)
+    arrays["__meta_schema"] = np.int64(_SCHEMA_VERSION)
+    np.savez_compressed(path, **arrays)
+
+
+def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:
+    """Rebuild a SimState (shaped by ``params``) from a checkpoint.
+
+    The params must describe the same simulation (tile count, cache
+    geometry, ...) that produced the checkpoint; shapes are verified.
+    """
+    template = make_state(params)
+    arrays, treedef = _flatten_with_paths(template)
+    with np.load(path) as z:
+        if int(z["__meta_schema"]) != _SCHEMA_VERSION:
+            raise ValueError(
+                f"checkpoint schema {int(z['__meta_schema'])} != "
+                f"{_SCHEMA_VERSION}")
+        steps = int(z["__meta_steps"])
+        leaves = []
+        for key, tmpl in arrays.items():
+            if key.startswith("__meta"):
+                continue
+            if key not in z:
+                raise ValueError(f"checkpoint missing field {key!r}")
+            a = z[key]
+            if a.shape != tmpl.shape:
+                raise ValueError(
+                    f"checkpoint field {key!r} shape {a.shape} != expected "
+                    f"{tmpl.shape} (params mismatch?)")
+            leaves.append(a.astype(tmpl.dtype, copy=False))
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    return state, steps

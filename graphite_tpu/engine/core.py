@@ -32,7 +32,7 @@ from graphite_tpu.engine import cache as cachemod
 from graphite_tpu.engine import noc
 from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_EX_REQ, PEND_IFETCH, PEND_MUTEX, PEND_NONE,
-    PEND_RECV, PEND_SH_REQ, SimState, TraceArrays)
+    PEND_RECV, PEND_SEND, PEND_SH_REQ, SimState, TraceArrays)
 from graphite_tpu.events.schema import ICACHE_BYTES_PER_INSTRUCTION
 from graphite_tpu.isa import DVFSModule, EventOp
 from graphite_tpu.params import SimParams
@@ -141,9 +141,12 @@ def local_advance(params: SimParams, state: SimState,
         dt_mem_l2 = l1d_ps + l2_ps + at_extra
 
         # --------------------------------------------- USER NETWORK (CAPI)
-        is_send = op == EventOp.SEND
+        is_send_op = op == EventOp.SEND
         is_recv = op == EventOp.RECV
         dst = jnp.clip(arg2, 0, T - 1)
+        ch_full = (st.ch_sent[rows, dst] - st.ch_recvd[rows, dst]) >= chan_depth
+        is_send = is_send_op & ~ch_full
+        send_block = is_send_op & ch_full
         send_net_ps = noc.unicast_ps(
             params.net_user, rows, dst, jnp.maximum(arg, 0), p_nu,
             params.mesh_width)
@@ -211,21 +214,31 @@ def local_advance(params: SimParams, state: SimState,
             new_clock)
 
         # ------------------------------------------------- blocking events
-        blocked = comp_block | mem_rem | is_recv | is_bar | is_lock
+        blocked = comp_block | mem_rem | is_recv | is_bar | is_lock \
+            | send_block
         kind = jnp.where(comp_block, PEND_IFETCH, PEND_NONE)
         kind = jnp.where(mem_rem & is_rd, PEND_SH_REQ, kind)
         kind = jnp.where(mem_rem & is_wr, PEND_EX_REQ, kind)
         kind = jnp.where(is_recv, PEND_RECV, kind)
         kind = jnp.where(is_bar, PEND_BARRIER, kind)
         kind = jnp.where(is_lock, PEND_MUTEX, kind)
+        kind = jnp.where(send_block, PEND_SEND, kind)
         pend_kind = jnp.where(blocked, kind, st.pend_kind)
         pend_addr = jnp.where(is_bar | is_lock, jnp.int64(arg),
-                              jnp.where(blocked, addr, st.pend_addr))
+                              jnp.where(send_block, jnp.int64(jnp.maximum(arg, 0)),
+                                        jnp.where(blocked, addr, st.pend_addr)))
         issue = st.clock + jnp.where(
             comp_block, l1i_ps + l2_tag_ps,
             jnp.where(mem_rem, l1d_ps + l2_tag_ps, cycle_ps))
         pend_issue = jnp.where(blocked, issue, st.pend_issue)
         pend_aux = jnp.where(blocked, arg2, st.pend_aux)
+        # Local cost still owed once the remote part resolves: a blocked
+        # COMPUTE block's execution + fetch time (minus the remotely
+        # fetched first line, which resolve prices), an atomic's RMW cycle.
+        extra = jnp.where(
+            comp_block, cost_ps + fetch_ps + (n_lines - 1) * l2_ps,
+            jnp.where(mem_rem, at_extra, 0))
+        pend_extra = jnp.where(blocked, extra, st.pend_extra)
 
         # ------------------------------------------------- cache updates
         l1i = cachemod.touch(st.l1i, pI.set_idx, pI.way, is_comp & pI.hit)
@@ -253,7 +266,7 @@ def local_advance(params: SimParams, state: SimState,
             icount=c.icount
             + jnp.where(is_comp, icount_ev, 0)
             + jnp.where((is_mem & (arg2 == 0)) | is_br, 1, 0),
-            l1i_access=c.l1i_access + jnp.where(comp_ok, icount_ev, 0)
+            l1i_access=c.l1i_access + jnp.where(is_comp, icount_ev, 0)
             + jnp.where(is_br, 1, 0),
             l1i_miss=c.l1i_miss + jnp.where(is_comp & ~pI.hit & active,
                                             n_lines, 0),
@@ -283,6 +296,7 @@ def local_advance(params: SimParams, state: SimState,
             pend_addr=pend_addr,
             pend_issue=pend_issue,
             pend_aux=pend_aux,
+            pend_extra=pend_extra,
             bp_table=bp_table,
             l1i=l1i, l1d=l1d, l2=l2,
             freq_ghz=freq_ghz,

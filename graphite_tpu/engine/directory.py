@@ -1,0 +1,134 @@
+"""Directory coherence: sharer bitmaps and the MSI transition function.
+
+Rebuilds the reference's DRAM-directory controller FSM (reference:
+common/tile/memory_subsystem/pr_l1_pr_l2_dram_directory_msi/
+dram_directory_cntlr.cc:44-369 — EX_REQ at :239-, SH_REQ at :315-) as a
+*pure function over request batches*: given the directory entry state for K
+in-flight requests, produce the new entry state plus the set of coherence
+actions (owner writeback/flush leg, sharer invalidations, DRAM data read)
+whose latencies the resolve phase prices.
+
+Sharer tracking here is the full_map scheme (reference:
+directory_entry_full_map.cc): one bit per tile packed into uint64 words.
+Limited schemes (limited_broadcast / limited_no_broadcast / ackwise /
+limitless, reference common/tile/memory_subsystem/directory_schemes/) are
+expressed as a cap on tracked sharers + an overflow broadcast policy and
+layer on the same arrays.
+
+Directory entry states (reference: directory_state.h): U(ncached)=0,
+S(hared)=1, M(odified)=2 — we reuse the cache-state codes I/S/M.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from graphite_tpu.engine.cache import I, M, S
+
+# ------------------------------------------------------------- bitmaps
+
+
+def make_tile_bit(tile: jnp.ndarray, num_words: int):
+    """tile id [K] -> ([K, W] uint64 one-hot bitmap)."""
+    word = (tile // 64).astype(jnp.int32)
+    bit = jnp.uint64(1) << (tile % 64).astype(jnp.uint64)
+    K = tile.shape[0]
+    words = jnp.zeros((K, num_words), dtype=jnp.uint64)
+    return words.at[jnp.arange(K), word].set(bit)
+
+
+def bitmap_to_bool(words: jnp.ndarray, num_tiles: int) -> jnp.ndarray:
+    """[K, W] uint64 -> [K, T] bool."""
+    K, W = words.shape
+    shifts = jnp.arange(64, dtype=jnp.uint64)
+    bits = (words[:, :, None] >> shifts[None, None, :]) & jnp.uint64(1)
+    return bits.reshape(K, W * 64)[:, :num_tiles].astype(bool)
+
+
+def popcount(words: jnp.ndarray) -> jnp.ndarray:
+    """[K, W] uint64 -> [K] int32 number of set bits."""
+    # jnp.bitwise_count is available in recent jax; fall back to manual.
+    try:
+        return jnp.sum(jnp.bitwise_count(words), axis=-1).astype(jnp.int32)
+    except AttributeError:  # pragma: no cover
+        x = words
+        c = jnp.zeros(words.shape[0], dtype=jnp.int32)
+        for _ in range(64):
+            c = c + jnp.sum((x & jnp.uint64(1)).astype(jnp.int32), axis=-1)
+            x = x >> jnp.uint64(1)
+        return c
+
+
+class MsiActions(NamedTuple):
+    """Per-request coherence actions + new entry contents (all [K])."""
+
+    new_state: jnp.ndarray     # int32 directory state after the request
+    new_owner: jnp.ndarray     # int32 owner tile (-1 when none)
+    new_sharers: jnp.ndarray   # [K, W] uint64
+    owner_leg: jnp.ndarray     # bool — WB/FLUSH round trip to current owner
+    owner_tile: jnp.ndarray    # int32 tile the owner leg visits
+    owner_downgrade_to: jnp.ndarray  # int32 state the owner's copies drop to
+    inv_targets: jnp.ndarray   # [K, W] uint64 — sharers to invalidate
+    dram_read: jnp.ndarray     # bool — data supplied by DRAM
+    dram_write: jnp.ndarray    # bool — writeback reaches DRAM (off critical path)
+
+
+def msi_transition(is_ex: jnp.ndarray, requester: jnp.ndarray,
+                   state: jnp.ndarray, owner: jnp.ndarray,
+                   sharers: jnp.ndarray, num_words: int) -> MsiActions:
+    """The MSI directory FSM for a batch of requests.
+
+    Cases (reference dram_directory_cntlr.cc):
+      SH_REQ: U -> S {req}, data from DRAM
+              S -> S +req, data from DRAM
+              M -> WB_REQ to owner (owner downgrades M->S, data written
+                   back), state S {owner, req}
+      EX_REQ: U -> M owner=req, data from DRAM
+              S -> INV_REQ to sharers\\{req}, then M owner=req, data DRAM
+              M -> FLUSH_REQ to owner (owner -> I), state M owner=req
+    A requester already recorded as owner (stale entry after a silent local
+    upgrade race) is never sent its own flush.
+    """
+    req_bit = make_tile_bit(requester, num_words)
+    has_owner = (state == M) & (owner >= 0) & (owner != requester)
+
+    # --- SH_REQ outcomes
+    sh_state = jnp.full_like(state, S)
+    sh_sharers = sharers | req_bit
+    sh_sharers = jnp.where(
+        (state == M)[:, None],
+        make_tile_bit(jnp.maximum(owner, 0), num_words) | req_bit,
+        sh_sharers)
+    sh_owner = jnp.full_like(owner, -1)
+
+    # --- EX_REQ outcomes
+    ex_state = jnp.full_like(state, M)
+    ex_sharers = req_bit
+    ex_owner = requester.astype(jnp.int32)
+    inv_targets = jnp.where(
+        (is_ex & (state == S))[:, None], sharers & ~req_bit,
+        jnp.zeros_like(sharers))
+
+    new_state = jnp.where(is_ex, ex_state, sh_state)
+    new_owner = jnp.where(is_ex, ex_owner, sh_owner)
+    new_sharers = jnp.where(is_ex[:, None], ex_sharers, sh_sharers)
+
+    owner_leg = has_owner
+    owner_downgrade = jnp.where(is_ex, I, S).astype(jnp.int32)
+    # Data comes from DRAM unless a live owner forwards it.
+    dram_read = ~owner_leg
+    dram_write = owner_leg  # WB/FLUSH data lands in DRAM (reference
+    #                         retrieveDataAndSendToL2Cache writes through)
+    return MsiActions(
+        new_state=new_state.astype(jnp.int32),
+        new_owner=new_owner.astype(jnp.int32),
+        new_sharers=new_sharers,
+        owner_leg=owner_leg,
+        owner_tile=jnp.maximum(owner, 0).astype(jnp.int32),
+        owner_downgrade_to=owner_downgrade,
+        inv_targets=inv_targets,
+        dram_read=dram_read,
+        dram_write=dram_write,
+    )

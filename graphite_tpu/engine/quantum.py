@@ -1,0 +1,80 @@
+"""The quantum engine: lax-barrier-synchronized stepping of all tiles.
+
+The reference bounds target-time skew with the lax-barrier scheme: every
+tile that crosses the current quantum boundary blocks at a barrier server
+on the MCP until all running tiles arrive, then the boundary advances one
+quantum (skipping empty quanta) and everyone releases (reference:
+clock_skew_management_schemes/lax_barrier_sync_server.cc:42-160, client
+:32-59; SURVEY.md 3.5).
+
+Here the same contract is a reduction: the boundary is recomputed from the
+min clock over runnable tiles (a `jnp.min` — under a sharded mesh this is
+the `lax.psum`-family collective the north star names), and a quantum step
+is ``rounds_per_quantum`` repetitions of (local_advance ; resolve).  Tiles
+parked on sync objects (barrier/mutex/recv) are excluded from the min —
+the reference likewise excludes sleeping/stalled threads from
+isBarrierReached (lax_barrier_sync_server.cc:88-115) — so producers can
+run ahead and release them.
+
+``lax`` (no sync) and ``lax_p2p`` (random-pair clamping) map onto the same
+engine: the quantum already bounds skew at least as tightly as either, so
+they differ only in the modeled sync *cost*, which is zero for all three
+(the reference charges no time for barrier waits either — wait time is
+simply simulated-time made equal across tiles).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from graphite_tpu.engine.core import local_advance
+from graphite_tpu.engine.resolve import resolve
+from graphite_tpu.engine.state import (
+    PEND_BARRIER, PEND_MUTEX, PEND_RECV, PEND_SEND, SimState, TraceArrays)
+from graphite_tpu.params import SimParams
+from graphite_tpu.time_base import TIME_MAX
+
+
+def next_boundary(params: SimParams, state: SimState) -> jnp.ndarray:
+    """Advance the barrier boundary past the slowest runnable tile,
+    skipping empty quanta (reference barrierRelease's quantum skip,
+    lax_barrier_sync_server.cc:118-160)."""
+    sync_blocked = ((state.pend_kind == PEND_RECV)
+                    | (state.pend_kind == PEND_BARRIER)
+                    | (state.pend_kind == PEND_MUTEX)
+                    | (state.pend_kind == PEND_SEND))
+    runnable = ~state.done & ~sync_blocked
+    min_clock = jnp.min(jnp.where(runnable, state.clock, TIME_MAX))
+    q = jnp.int64(params.quantum_ps)
+    nb = (min_clock // q + 1) * q
+    return jnp.where(runnable.any(), nb,
+                     state.boundary + q).astype(jnp.int64)
+
+
+def quantum_step(params: SimParams, state: SimState,
+                 trace: TraceArrays) -> SimState:
+    """One barrier quantum: all tiles advance to the new boundary."""
+    state = state._replace(boundary=next_boundary(params, state))
+
+    def sub_round(_, st):
+        st = local_advance(params, st, trace)
+        st = resolve(params, st)
+        return st
+
+    return jax.lax.fori_loop(0, params.rounds_per_quantum, sub_round, state)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def megastep(params: SimParams, state: SimState,
+             trace: TraceArrays) -> SimState:
+    """``quanta_per_step`` quantum steps fused into one device program —
+    the unit the host driver launches (and the unit `bench.py` times)."""
+
+    def body(st, _):
+        return quantum_step(params, st, trace), None
+
+    state, _ = jax.lax.scan(body, state, None, length=params.quanta_per_step)
+    return state

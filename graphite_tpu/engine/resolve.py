@@ -1,0 +1,442 @@
+"""Cross-tile resolution of pending requests — the engine's "sim-thread side".
+
+In the reference, anything one tile needs from another travels as modeled
+packets serviced by per-tile sim threads and MCP server threads: shared-
+memory requests walk L2 -> home DRAM-directory -> owner/sharers -> back
+(reference: common/tile/memory_subsystem/pr_l1_pr_l2_dram_directory_msi/
+dram_directory_cntlr.cc, call stack SURVEY.md 3.3); sync ops are served by
+the MCP's SyncServer (common/system/sync_server.h); CAPI receives match
+sends in Network::netRecv (common/network/network.cc:358).
+
+Here, all of that is one batched phase per sub-round: every parked request
+from every tile is priced and applied simultaneously with gathers/scatters
+over the tile-sharded state.  Same-line races — which the reference
+serializes through the home directory's FSM (with NULLIFY/retry) — are
+serialized by *conflict rounds*: per round, only each line's earliest
+pending request transacts; later requests observe the post-transaction
+directory state in a later round and are charged the wait through a
+per-line availability floor.  Requests left after
+``directory_conflict_rounds`` rounds simply stay parked for the next
+sub-round — bounded work per step, no starvation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.engine import directory as dirmod
+from graphite_tpu.engine import noc
+from graphite_tpu.engine import queue_models
+from graphite_tpu.engine.core import _lat, _period, mcp_tile
+from graphite_tpu.engine.state import (
+    PEND_BARRIER, PEND_EX_REQ, PEND_IFETCH, PEND_MUTEX, PEND_NONE,
+    PEND_RECV, PEND_SEND, PEND_SH_REQ, SimState)
+from graphite_tpu.isa import DVFSModule
+from graphite_tpu.params import SimParams
+
+I, S, M = cachemod.I, cachemod.S, cachemod.M
+
+# Control-message payload bytes (request/inv/ack packets; reference
+# ShmemMsg header, shmem_msg.h:12-29).
+CTRL_BYTES = 8
+
+
+def home_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
+    """Home memory-controller tile for a line: interleave lines across the
+    controllers, controllers spread over the mesh with a fixed stride
+    (reference: address_home_lookup.cc + [dram] controller placement)."""
+    n = params.dram.num_controllers
+    return ((line % n) * params.dram.controller_home_stride).astype(jnp.int32)
+
+
+def _unblock(state: SimState, mask, completion, sync: bool) -> SimState:
+    c = state.counters
+    stall = jnp.where(mask, completion - state.pend_issue, 0)
+    if sync:
+        c = c._replace(sync_stall_ps=c.sync_stall_ps + stall)
+    else:
+        c = c._replace(mem_stall_ps=c.mem_stall_ps + stall)
+    return state._replace(
+        clock=jnp.where(mask, completion, state.clock),
+        cursor=state.cursor + jnp.where(mask, 1, 0),
+        pend_kind=jnp.where(mask, PEND_NONE, state.pend_kind),
+        counters=c,
+    )
+
+
+# ===================================================================== memory
+
+def resolve_memory(params: SimParams, state: SimState) -> SimState:
+    T = params.num_tiles
+    W = state.dir_sharers.shape[-1]
+    A = params.directory.associativity
+    rows = jnp.arange(T)
+    line_bits = params.line_size.bit_length() - 1
+    nctl = params.dram.num_controllers
+
+    is_req = ((state.pend_kind == PEND_SH_REQ)
+              | (state.pend_kind == PEND_EX_REQ)
+              | (state.pend_kind == PEND_IFETCH))
+    line = state.pend_addr >> line_bits
+    is_ex = state.pend_kind == PEND_EX_REQ
+    is_if = state.pend_kind == PEND_IFETCH
+    home = home_of_line(params, line)
+    dset = ((line // nctl) % params.directory.num_sets).astype(jnp.int32)
+    issue = state.pend_issue
+
+    # Per-tile clock periods.
+    p_net = _period(state, DVFSModule.NETWORK_MEMORY)
+    p_dir = _period(state, DVFSModule.DIRECTORY)
+    p_l2 = _period(state, DVFSModule.L2_CACHE)
+    p_l1 = _period(state, DVFSModule.L1_DCACHE)
+    p_core = _period(state, DVFSModule.CORE)
+    cycle_ps = _lat(1, p_core)
+
+    dram_access_ps = jnp.int64(params.dram.latency_ps)
+    dram_service_ps = jnp.int64(
+        params.dram.processing_ps_per_line(params.line_size))
+
+    def round_body(_, carry):
+        state, resolved, line_floor = carry
+        c = state.counters
+        unres = is_req & ~resolved
+
+        # ---- earliest-per-line election (the directory FSM serialization)
+        same = (line[:, None] == line[None, :]) \
+            & unres[:, None] & unres[None, :]
+        earlier = (issue[None, :] < issue[:, None]) \
+            | ((issue[None, :] == issue[:, None])
+               & (rows[None, :] < rows[:, None]))
+        win = unres & ~(same & earlier).any(axis=1)
+
+        # ---- directory-cache probe at (home, dset)
+        dtags = state.dir_tags[home, dset]      # [T, A]
+        dstate = state.dir_state[home, dset]
+        match = (dtags == line[:, None]) & (dstate != I)
+        hit = match.any(axis=1)
+        hway = jnp.argmax(match, axis=1).astype(jnp.int32)
+        dlru = state.dir_lru[home, dset]
+        invalid = dstate == I
+        alloc_way = jnp.where(invalid.any(axis=1),
+                              jnp.argmax(invalid, axis=1),
+                              jnp.argmax(dlru, axis=1)).astype(jnp.int32)
+        way = jnp.where(hit, hway, alloc_way)
+        evicting = win & ~hit & ~invalid.any(axis=1)
+
+        entry_state = jnp.where(
+            hit, jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
+        entry_owner = jnp.where(
+            hit,
+            jnp.take_along_axis(state.dir_owner[home, dset], way[:, None],
+                                axis=1)[:, 0], -1)
+        entry_sharers = jnp.where(
+            hit[:, None],
+            jnp.take_along_axis(
+                state.dir_sharers[home, dset], way[:, None, None],
+                axis=1)[:, 0, :],
+            jnp.zeros((T, W), dtype=jnp.uint64))
+
+        act = dirmod.msi_transition(is_ex, rows, entry_state, entry_owner,
+                                    entry_sharers, W)
+
+        # ---- latency assembly (SURVEY.md 3.3's round trips, analytically)
+        net_req = noc.unicast_ps(params.net_memory, rows, home, CTRL_BYTES,
+                                 p_net, params.mesh_width)
+        arrive = jnp.maximum(issue + net_req, line_floor)
+        dir_ps = _lat(params.directory.access_cycles, p_dir[home])
+        t_dir = arrive + dir_ps
+
+        owner = act.owner_tile
+        owner_leg = act.owner_leg & win
+        leg_ps = noc.unicast_ps(params.net_memory, home, owner, CTRL_BYTES,
+                                p_net[home], params.mesh_width) \
+            + _lat(params.l2.access_cycles, p_l2[owner]) \
+            + noc.unicast_ps(params.net_memory, owner, home,
+                             params.line_size + CTRL_BYTES, p_net[owner],
+                             params.mesh_width)
+        owner_ps = jnp.where(owner_leg, leg_ps, 0)
+
+        inv_bool = dirmod.bitmap_to_bool(act.inv_targets, T)  # [Treq, Ttgt]
+        inv_bool = inv_bool & win[:, None]
+        has_inv = inv_bool.any(axis=1)
+        inv_ps = jnp.where(
+            has_inv,
+            2 * noc.max_hop_to_mask_ps(params.net_memory, home, inv_bool,
+                                       CTRL_BYTES, p_net[home],
+                                       params.mesh_width) + cycle_ps, 0)
+
+        need_read = win & act.dram_read
+        dram_arrival = t_dir + owner_ps
+        q = queue_models.fcfs(home, dram_arrival,
+                              jnp.full(T, dram_service_ps), need_read,
+                              state.dram_free_at)
+        dram_ready = q.start + dram_access_ps + dram_service_ps
+        state = state._replace(dram_free_at=q.free_at)
+        # Writebacks from an owner leg occupy the controller off the
+        # critical path (write buffer): add occupancy only.
+        state = state._replace(dram_free_at=state.dram_free_at.at[
+            jnp.where(owner_leg, home, T)].add(dram_service_ps, mode="drop"))
+
+        t_data = t_dir + owner_ps
+        t_data = jnp.maximum(t_data, jnp.where(need_read, dram_ready, 0))
+        t_data = jnp.maximum(t_data, t_dir + inv_ps)
+
+        reply_ps = noc.unicast_ps(params.net_memory, home, rows,
+                                  params.line_size + CTRL_BYTES, p_net[home],
+                                  params.mesh_width)
+        l2_fill_ps = _lat(params.l2.access_cycles, p_l2)
+        l1_fill_ps = jnp.where(
+            is_if, _lat(params.l1i.access_cycles,
+                        _period(state, DVFSModule.L1_ICACHE)),
+            _lat(params.l1d.access_cycles, p_l1))
+        completion = t_data + reply_ps + l2_fill_ps + l1_fill_ps \
+            + state.pend_extra
+
+        # ---- apply directory entry updates (scatter at home slices)
+        home_w = jnp.where(win, home, T).astype(jnp.int32)
+        state = state._replace(
+            dir_tags=state.dir_tags.at[home_w, dset, way].set(
+                line, mode="drop"),
+            dir_state=state.dir_state.at[home_w, dset, way].set(
+                act.new_state, mode="drop"),
+            dir_owner=state.dir_owner.at[home_w, dset, way].set(
+                act.new_owner, mode="drop"),
+            dir_sharers=state.dir_sharers.at[home_w, dset, way].set(
+                act.new_sharers, mode="drop"),
+        )
+        # Dir LRU: promote the touched way (whole-row scatter; colliding
+        # same-set winners resolve arbitrarily — bounded inaccuracy).
+        r_w = jnp.take_along_axis(dlru, way[:, None], axis=1)
+        promoted = jnp.where(jnp.arange(A)[None, :] == way[:, None], 0,
+                             dlru + (dlru < r_w))
+        state = state._replace(
+            dir_lru=state.dir_lru.at[home_w, dset].set(
+                jnp.where(win[:, None], promoted, dlru), mode="drop"))
+
+        # ---- owner downgrade / sharer invalidation scatters
+        pair_valid = owner_leg
+        pairs = jnp.stack(
+            [owner.astype(jnp.int64), line], axis=1)
+        l2c, _ = cachemod.invalidate_lines(
+            state.l2, pairs, pair_valid, params.l2.num_sets,
+            act.owner_downgrade_to)
+        l1c, _ = cachemod.invalidate_lines(
+            state.l1d, pairs, pair_valid, params.l1d.num_sets,
+            act.owner_downgrade_to)
+        state = state._replace(l2=l2c, l1d=l1c)
+
+        tgt = jnp.broadcast_to(rows[None, :], (T, T)).reshape(-1)
+        lin = jnp.broadcast_to(line[:, None], (T, T)).reshape(-1)
+        ipairs = jnp.stack([tgt.astype(jnp.int64), lin], axis=1)
+        ivalid = inv_bool.reshape(-1)
+        l2c, _ = cachemod.invalidate_lines(
+            state.l2, ipairs, ivalid, params.l2.num_sets, I)
+        l1c, _ = cachemod.invalidate_lines(
+            state.l1d, ipairs, ivalid, params.l1d.num_sets, I)
+        state = state._replace(l2=l2c, l1d=l1c)
+
+        # ---- requester-side fills (L2 always; L1D or L1I by request kind)
+        f2 = cachemod.fill(state.l2, line,
+                           jnp.where(is_ex, M, S).astype(jnp.int32),
+                           win, params.l2.num_sets, params.l2.replacement)
+        state = state._replace(l2=f2.cache)
+        victim_dirty = win & (f2.victim_state == M)
+        victim_home = home_of_line(params, f2.victim_tag)
+        state = state._replace(dram_free_at=state.dram_free_at.at[
+            jnp.where(victim_dirty, victim_home, T)].add(
+                dram_service_ps, mode="drop"))
+        # An evicted-from-L2 line also leaves L1 (inclusive hierarchy,
+        # reference l2_cache_cntlr invalidation of L1 on eviction).
+        vpairs = jnp.stack([rows.astype(jnp.int64), f2.victim_tag], axis=1)
+        l1c, _ = cachemod.invalidate_lines(
+            state.l1d, vpairs, win & (f2.victim_state != I),
+            params.l1d.num_sets, I)
+        state = state._replace(l1d=l1c)
+
+        fd = cachemod.fill(state.l1d, line,
+                           jnp.where(is_ex, M, S).astype(jnp.int32),
+                           win & ~is_if, params.l1d.num_sets,
+                           params.l1d.replacement)
+        state = state._replace(l1d=fd.cache)
+        fi = cachemod.fill(state.l1i, line,
+                           jnp.full(T, S, dtype=jnp.int32),
+                           win & is_if, params.l1i.num_sets,
+                           params.l1i.replacement)
+        state = state._replace(l1i=fi.cache)
+
+        # ---- counters
+        def sadd(arr, idx, mask, val=1):
+            return arr.at[jnp.where(mask, idx, T)].add(val, mode="drop")
+
+        inv_count = jnp.where(win, jnp.sum(inv_bool, axis=1), 0)
+        flits_req = noc.num_flits(CTRL_BYTES,
+                                  params.net_memory.flit_width_bits)
+        flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
+                                   params.net_memory.flit_width_bits)
+        c = state.counters
+        c = c._replace(
+            dir_sh_req=sadd(c.dir_sh_req, home, win & ~is_ex),
+            dir_ex_req=sadd(c.dir_ex_req, home, win & is_ex),
+            dir_invalidations=sadd(c.dir_invalidations, home,
+                                   inv_count > 0, inv_count),
+            dir_writebacks=sadd(c.dir_writebacks, home, owner_leg),
+            dir_evictions=sadd(c.dir_evictions, home, evicting),
+            dram_reads=sadd(c.dram_reads, home, need_read),
+            dram_writes=sadd(
+                sadd(c.dram_writes, home, owner_leg),
+                victim_home, victim_dirty),
+            net_mem_pkts=c.net_mem_pkts
+            + jnp.where(win, 1, 0)                    # request
+            + jnp.where(victim_dirty, 1, 0),          # victim WB data
+            net_mem_flits=c.net_mem_flits
+            + jnp.where(win, flits_req, 0)
+            + jnp.where(victim_dirty, flits_data, 0),
+        )
+        # reply + inv/flush traffic accounted at the home tile
+        c = c._replace(
+            net_mem_pkts=sadd(
+                sadd(c.net_mem_pkts, home, win),       # reply
+                home, inv_count > 0, inv_count),        # INV_REQs
+            net_mem_flits=sadd(
+                sadd(c.net_mem_flits, home, win, flits_data),
+                home, inv_count > 0, inv_count * flits_req),
+        )
+        state = state._replace(counters=c)
+
+        state = _unblock(state, win, completion, sync=False)
+
+        # ---- serialization floor for still-pending same-line requests
+        t_free = t_data
+        floor_cand = jnp.max(
+            jnp.where((line[:, None] == line[None, :]) & win[None, :],
+                      t_free[None, :], 0), axis=1)
+        line_floor = jnp.maximum(line_floor, floor_cand)
+        resolved = resolved | win
+        return state, resolved, line_floor
+
+    carry = (state, jnp.zeros(T, dtype=bool), jnp.zeros(T, dtype=jnp.int64))
+    state, _, _ = jax.lax.fori_loop(
+        0, params.directory_conflict_rounds, round_body, carry)
+    return state
+
+
+# ====================================================================== sync
+
+def resolve_recv(params: SimParams, state: SimState) -> SimState:
+    T = params.num_tiles
+    rows = jnp.arange(T)
+    D = state.ch_time.shape[2]
+    is_recv = state.pend_kind == PEND_RECV
+    src = jnp.clip(state.pend_aux, 0, T - 1)
+    sent = state.ch_sent[src, rows]
+    recvd = state.ch_recvd[src, rows]
+    avail = sent > recvd
+    slot = recvd % D
+    arr = state.ch_time[src, rows, slot]
+    ok = is_recv & avail
+    cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
+    completion = jnp.maximum(state.pend_issue, arr) + cycle_ps
+    src_eff = jnp.where(ok, src, T)
+    state = state._replace(
+        ch_recvd=state.ch_recvd.at[src_eff, rows].add(1, mode="drop"),
+        counters=state.counters._replace(
+            recvs=state.counters.recvs + jnp.where(ok, 1, 0)))
+    return _unblock(state, ok, completion, sync=True)
+
+
+def resolve_send(params: SimParams, state: SimState) -> SimState:
+    """Complete sends that were back-pressured by a full channel ring."""
+    T = params.num_tiles
+    rows = jnp.arange(T)
+    D = state.ch_time.shape[2]
+    is_send = state.pend_kind == PEND_SEND
+    dst = jnp.clip(state.pend_aux, 0, T - 1)
+    space = (state.ch_sent[rows, dst] - state.ch_recvd[rows, dst]) < D
+    ok = is_send & space
+    p_nu = _period(state, DVFSModule.NETWORK_USER)
+    cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
+    net_ps = noc.unicast_ps(params.net_user, rows, dst, state.pend_addr,
+                            p_nu, params.mesh_width)
+    completion = state.pend_issue + cycle_ps
+    arrival = completion + net_ps
+    slot = state.ch_sent[rows, dst] % D
+    src_eff = jnp.where(ok, rows, T).astype(jnp.int32)
+    state = state._replace(
+        ch_time=state.ch_time.at[src_eff, dst, slot].set(arrival, mode="drop"),
+        ch_sent=state.ch_sent.at[src_eff, dst].add(1, mode="drop"),
+        counters=state.counters._replace(
+            sends=state.counters.sends + jnp.where(ok, 1, 0),
+            net_user_pkts=state.counters.net_user_pkts + jnp.where(ok, 1, 0),
+            net_user_flits=state.counters.net_user_flits + jnp.where(
+                ok, noc.num_flits(state.pend_addr,
+                                  params.net_user.flit_width_bits), 0)))
+    return _unblock(state, ok, completion, sync=True)
+
+
+def resolve_barrier(params: SimParams, state: SimState) -> SimState:
+    T = params.num_tiles
+    rows = jnp.arange(T)
+    NB = state.bar_count.shape[0]
+    is_bar = state.pend_kind == PEND_BARRIER
+    bid = jnp.clip(state.pend_addr, 0, NB - 1).astype(jnp.int32)
+    parts = jnp.maximum(state.pend_aux, 1)
+    reached = state.bar_count[bid] >= parts
+    rel = is_bar & reached
+    p_nu = _period(state, DVFSModule.NETWORK_USER)
+    cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
+    back_ps = noc.unicast_ps(params.net_user,
+                             jnp.full(T, mcp_tile(params)), rows, CTRL_BYTES,
+                             p_nu[mcp_tile(params)], params.mesh_width)
+    completion = state.bar_time[bid] + back_ps + cycle_ps
+    # reset released barriers for their next generation
+    bid_eff = jnp.where(rel, bid, NB)
+    state = state._replace(
+        bar_count=state.bar_count.at[bid_eff].set(0, mode="drop"),
+        bar_time=state.bar_time.at[bid_eff].set(0, mode="drop"))
+    return _unblock(state, rel, completion, sync=True)
+
+
+def resolve_mutex(params: SimParams, state: SimState) -> SimState:
+    T = params.num_tiles
+    rows = jnp.arange(T)
+    NL = state.lock_holder.shape[0]
+    is_mx = state.pend_kind == PEND_MUTEX
+    lid = jnp.clip(state.pend_addr, 0, NL - 1).astype(jnp.int32)
+    issue = state.pend_issue
+    # FCFS: earliest waiter per free lock wins (SimMutex wakeup order,
+    # sync_server.cc).
+    same = (lid[:, None] == lid[None, :]) & is_mx[:, None] & is_mx[None, :]
+    earlier = (issue[None, :] < issue[:, None]) \
+        | ((issue[None, :] == issue[:, None]) & (rows[None, :] < rows[:, None]))
+    first = is_mx & ~(same & earlier).any(axis=1)
+    free = state.lock_holder[lid] == 0
+    win = first & free
+    p_nu = _period(state, DVFSModule.NETWORK_USER)
+    cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
+    mcp = mcp_tile(params)
+    to_mcp = noc.unicast_ps(params.net_user, rows, jnp.full(T, mcp),
+                            CTRL_BYTES, p_nu, params.mesh_width)
+    from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
+                              CTRL_BYTES, p_nu[mcp], params.mesh_width)
+    grant = jnp.maximum(issue + to_mcp, state.lock_free_at[lid])
+    completion = grant + from_mcp + cycle_ps
+    lid_eff = jnp.where(win, lid, NL)
+    state = state._replace(
+        lock_holder=state.lock_holder.at[lid_eff].set(
+            (rows + 1).astype(jnp.int32), mode="drop"),
+        counters=state.counters._replace(
+            mutex_acquires=state.counters.mutex_acquires
+            + jnp.where(win, 1, 0)))
+    return _unblock(state, win, completion, sync=True)
+
+
+def resolve(params: SimParams, state: SimState) -> SimState:
+    """One full cross-tile resolution pass."""
+    state = resolve_memory(params, state)
+    state = resolve_recv(params, state)
+    state = resolve_send(params, state)
+    state = resolve_barrier(params, state)
+    state = resolve_mutex(params, state)
+    return state

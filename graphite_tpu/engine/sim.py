@@ -1,0 +1,202 @@
+"""Host-side simulation driver + end-of-run summary.
+
+Driver: the role of the reference's Simulator singleton + sim-thread
+manager (reference: common/system/simulator.cc:83-203) collapses to a small
+host loop launching fused device steps (engine/quantum.py) and polling
+termination — there are no server threads to start or join.
+
+Summary: the reference aggregates every component's outputSummary() into
+one ``sim.out`` on process 0 (reference: simulator.cc:135-170,
+tile_manager_summary.cc); here the counters already live in device arrays,
+so the summary is one device->host transfer + formatting.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from graphite_tpu.config import Config
+from graphite_tpu.engine.quantum import megastep
+from graphite_tpu.engine.state import SimState, TraceArrays, make_state
+from graphite_tpu.events.schema import Trace
+from graphite_tpu.params import SimParams
+from graphite_tpu.time_base import ps_to_ns
+
+
+class SimSummary:
+    """Counter roll-up with sim.out-style rendering."""
+
+    def __init__(self, params: SimParams, state: SimState,
+                 host_seconds: float, steps: int):
+        self.params = params
+        self.host_seconds = host_seconds
+        self.steps = steps
+        self.clock = np.asarray(state.clock)
+        self.done = np.asarray(state.done)
+        self.counters: Dict[str, np.ndarray] = {
+            f: np.asarray(getattr(state.counters, f))
+            for f in state.counters._fields
+        }
+
+    # ------------------------------------------------------------ metrics
+
+    @property
+    def completion_time_ps(self) -> int:
+        return int(self.clock.max())
+
+    @property
+    def total_instructions(self) -> int:
+        return int(self.counters["icount"].sum())
+
+    @property
+    def simulated_mips(self) -> float:
+        if self.host_seconds <= 0:
+            return float("inf")
+        return self.total_instructions / self.host_seconds / 1e6
+
+    def to_dict(self) -> Dict:
+        agg = {k: int(v.sum()) for k, v in self.counters.items()}
+        return {
+            "num_tiles": self.params.num_tiles,
+            "completion_time_ns": ps_to_ns(self.completion_time_ps),
+            "host_seconds": self.host_seconds,
+            "device_steps": self.steps,
+            "total_instructions": self.total_instructions,
+            "simulated_mips": self.simulated_mips,
+            "all_done": bool(self.done.all()),
+            "aggregate": agg,
+        }
+
+    def render(self) -> str:
+        c = self.counters
+        agg = {k: v.sum() for k, v in c.items()}
+        lines = []
+        w = 46
+        def row(k, v):
+            lines.append(f"    {k:<{w}}: {v}")
+        lines.append("[general]")
+        row("Total Tiles", self.params.num_tiles)
+        row("Completion Time (in ns)", f"{ps_to_ns(self.completion_time_ps):.1f}")
+        row("Total Instructions", agg["icount"])
+        row("Host Time (in s)", f"{self.host_seconds:.3f}")
+        row("Simulated MIPS", f"{self.simulated_mips:.3f}")
+        lines.append("[core]")
+        row("Total Instructions", agg["icount"])
+        row("Branches", agg["branches"])
+        row("Branch Mispredictions", agg["mispredicts"])
+        lines.append("[l1_icache]")
+        row("Cache Accesses", agg["l1i_access"])
+        row("Cache Misses", agg["l1i_miss"])
+        lines.append("[l1_dcache]")
+        row("Read Accesses", agg["l1d_read"])
+        row("Read Misses", agg["l1d_read_miss"])
+        row("Write Accesses", agg["l1d_write"])
+        row("Write Misses", agg["l1d_write_miss"])
+        lines.append("[l2_cache]")
+        row("Cache Accesses", agg["l2_access"])
+        row("Cache Misses", agg["l2_miss"])
+        lines.append("[dram_directory]")
+        row("Shared Requests", agg["dir_sh_req"])
+        row("Exclusive Requests", agg["dir_ex_req"])
+        row("Invalidations", agg["dir_invalidations"])
+        row("Writebacks", agg["dir_writebacks"])
+        row("Evictions", agg["dir_evictions"])
+        lines.append("[dram]")
+        row("Reads", agg["dram_reads"])
+        row("Writes", agg["dram_writes"])
+        lines.append("[network (memory)]")
+        row("Packets", agg["net_mem_pkts"])
+        row("Flits", agg["net_mem_flits"])
+        lines.append("[network (user)]")
+        row("Packets", agg["net_user_pkts"])
+        row("Flits", agg["net_user_flits"])
+        lines.append("[sync]")
+        row("Barriers", agg["barriers"])
+        row("Mutex Acquires", agg["mutex_acquires"])
+        row("Messages Sent", agg["sends"])
+        row("Messages Received", agg["recvs"])
+        lines.append("[stalls]")
+        row("Memory Stall (in ns, total)", f"{ps_to_ns(agg['mem_stall_ps']):.1f}")
+        row("Sync Stall (in ns, total)", f"{ps_to_ns(agg['sync_stall_ps']):.1f}")
+        return "\n".join(lines) + "\n"
+
+
+class DeadlockError(RuntimeError):
+    """No tile made progress across a full polling window — the trace is
+    waiting on something that can never happen (e.g. mismatched barrier
+    participant counts)."""
+
+
+class Simulator:
+    """Headless simulator-as-library (the MODE= pattern of the reference's
+    unit tests, tests/unit/shared_mem_basic/Makefile:6)."""
+
+    def __init__(self, params: SimParams, trace: Trace):
+        if trace.num_tiles != params.num_tiles:
+            raise ValueError(
+                f"trace has {trace.num_tiles} tiles, params expect "
+                f"{params.num_tiles}")
+        self.params = params
+        self.trace = TraceArrays.from_trace(trace)
+        self.state = make_state(params)
+        self.steps = 0
+        self.host_seconds = 0.0
+
+    def run(self, max_steps: Optional[int] = None,
+            poll_every: int = 8) -> SimSummary:
+        """Run megasteps until every tile is DONE (or max_steps)."""
+        t0 = time.perf_counter()
+        last_progress = None
+        while True:
+            for _ in range(poll_every):
+                self.state = megastep(self.params, self.state, self.trace)
+                self.steps += 1
+                if max_steps is not None and self.steps >= max_steps:
+                    break
+            done, cursor_sum, clock_sum = jax.device_get(
+                (self.state.done.all(), self.state.cursor.sum(),
+                 self.state.clock.sum()))
+            if bool(done):
+                break
+            if max_steps is not None and self.steps >= max_steps:
+                break
+            progress = (int(cursor_sum), int(clock_sum))
+            if progress == last_progress:
+                raise DeadlockError(
+                    f"no progress after {self.steps} steps "
+                    f"(cursor_sum={cursor_sum}, clock_sum={clock_sum})")
+            last_progress = progress
+        self.host_seconds = time.perf_counter() - t0
+        return self.summary()
+
+    def summary(self) -> SimSummary:
+        return SimSummary(self.params, self.state, self.host_seconds,
+                          self.steps)
+
+    # -------------------------------------------------- checkpoint/resume
+    # (absent in the reference — SURVEY.md section 5.4; pure-array state
+    # makes it a flatten+save here)
+
+    def save_checkpoint(self, path: str) -> None:
+        from graphite_tpu.engine.checkpoint import save_checkpoint
+        save_checkpoint(path, self.state, self.steps)
+
+    def restore_checkpoint(self, path: str) -> None:
+        from graphite_tpu.engine.checkpoint import load_checkpoint
+        self.state, self.steps = load_checkpoint(path, self.params)
+
+
+def run_simulation(params: SimParams, trace: Trace,
+                   max_steps: Optional[int] = None) -> SimSummary:
+    return Simulator(params, trace).run(max_steps=max_steps)
+
+
+def run_simulation_from_trace(cfg: Config, trace_path: str) -> SimSummary:
+    """CLI entry (graphite_tpu.cli 'run')."""
+    trace = Trace.load(trace_path)
+    params = SimParams.from_config(cfg, num_tiles=trace.num_tiles)
+    return run_simulation(params, trace)

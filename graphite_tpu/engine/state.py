@@ -34,6 +34,10 @@ PEND_IFETCH = 3     # instruction-fetch L2 miss (read-only SH_REQ)
 PEND_RECV = 4       # blocking user-network receive (CAPI)
 PEND_BARRIER = 5    # SimBarrier wait
 PEND_MUTEX = 6      # SimMutex acquire
+PEND_SEND = 7       # user-network send waiting for channel-buffer space
+#   (models the finite receive-side buffering the reference gets from its
+#   per-tile net queues; CAPI sends block in Network::netSend when the
+#   transport back-pressures)
 
 NUM_DVFS_MODULES = len(DVFSModule)
 
@@ -110,6 +114,9 @@ class SimState(NamedTuple):
     pend_addr: jnp.ndarray    # [T] int64 byte address / object id
     pend_issue: jnp.ndarray   # [T] int64 ps when the request left the tile
     pend_aux: jnp.ndarray     # [T] int32 (recv src / barrier participants)
+    pend_extra: jnp.ndarray   # [T] int64 ps of local cost to add on top of
+    #   the resolved remote latency (e.g. a blocked COMPUTE block's own
+    #   cost + fetch time, an atomic's RMW cycle)
 
     # -- branch predictor (reference: one_bit_branch_predictor.cc)
     bp_table: jnp.ndarray     # [T, bp_size] bool — last outcome per slot
@@ -126,7 +133,7 @@ class SimState(NamedTuple):
     dir_tags: jnp.ndarray     # [T, dsets, dassoc] int64 line
     dir_state: jnp.ndarray    # [T, dsets, dassoc] int32 (I/S/M dir states)
     dir_owner: jnp.ndarray    # [T, dsets, dassoc] int32 owner tile (M/O state)
-    dir_sharers: jnp.ndarray  # [T, dsets, dassoc, W] int64 sharer bitmap words
+    dir_sharers: jnp.ndarray  # [T, dsets, dassoc, W] uint64 sharer bitmap words
     dir_lru: jnp.ndarray      # [T, dsets, dassoc] int32
 
     # -- memory controllers (reference: dram_cntlr.h + dram_perf_model.h)
@@ -169,6 +176,7 @@ def make_state(params: SimParams,
         pend_addr=jnp.zeros(T, dtype=jnp.int64),
         pend_issue=jnp.zeros(T, dtype=jnp.int64),
         pend_aux=jnp.zeros(T, dtype=jnp.int32),
+        pend_extra=jnp.zeros(T, dtype=jnp.int64),
         bp_table=jnp.zeros((T, params.core.bp_size), dtype=bool),
         l1i=cachemod.make_cache(T, params.l1i),
         l1d=cachemod.make_cache(T, params.l1d),
@@ -177,7 +185,7 @@ def make_state(params: SimParams,
         dir_tags=jnp.zeros(d_shape, dtype=jnp.int64),
         dir_state=jnp.zeros(d_shape, dtype=jnp.int32),
         dir_owner=jnp.full(d_shape, -1, dtype=jnp.int32),
-        dir_sharers=jnp.zeros(d_shape + (W,), dtype=jnp.int64),
+        dir_sharers=jnp.zeros(d_shape + (W,), dtype=jnp.uint64),
         dir_lru=jnp.tile(
             jnp.arange(params.directory.associativity, dtype=jnp.int32),
             d_shape[:2] + (1,)),

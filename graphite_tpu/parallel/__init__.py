@@ -1,0 +1,4 @@
+"""Device-mesh distribution of the simulation (tile-axis sharding)."""
+
+from graphite_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, shard_pytree, tile_sharding)

@@ -1,0 +1,54 @@
+"""Tile-axis sharding over a jax device mesh.
+
+This is the TPU-native replacement for the reference's multi-process
+distribution: Graphite partitions target tiles across host processes with
+TCP sockets carrying modeled packets between them and a process barrier in
+the transport (reference: common/misc/config.h:173
+computeProcessToTileMapping, common/transport/socktransport.cc:61-287).
+Here the tile axis of every state array is sharded over a
+``jax.sharding.Mesh``; cross-tile gathers/scatters in the resolve phase
+(requests to home directories, invalidation fan-out) compile to XLA
+collectives riding ICI, and the quantum min-reduction is the barrier.
+
+Multi-host scaling rides the same mechanism: `jax.distributed` extends the
+mesh across hosts (ICI within a slice, DCN across), with no engine changes
+— the reference needed ssh spawners and a socket fabric for the same reach
+(tools/spawn_master.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+TILE_AXIS = "tiles"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              axis: str = TILE_AXIS) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (axis,))
+
+
+def tile_sharding(mesh: Mesh, num_tiles: int):
+    """Sharding-spec pytree builder: arrays with a leading tile axis are
+    split over the mesh; global arrays (sync objects, the quantum boundary)
+    are replicated."""
+
+    def spec_for(leaf: Any):
+        shape = np.shape(leaf)
+        if len(shape) >= 1 and shape[0] == num_tiles:
+            return NamedSharding(mesh, P(TILE_AXIS))
+        return NamedSharding(mesh, P())
+
+    return spec_for
+
+
+def shard_pytree(tree: Any, mesh: Mesh, num_tiles: int) -> Any:
+    """Place a pytree (SimState / TraceArrays) onto the mesh, tile-sharded."""
+    spec = tile_sharding(mesh, num_tiles)
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, spec(leaf)), tree)

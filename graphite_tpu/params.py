@@ -337,6 +337,7 @@ class SimParams:
     # TPU engine knobs
     max_events_per_quantum: int
     directory_conflict_rounds: int
+    rounds_per_quantum: int
     quanta_per_step: int
 
     @property
@@ -408,5 +409,6 @@ class SimParams:
             technology_node=cfg.get_int("general/technology_node"),
             max_events_per_quantum=cfg.get_int("tpu/max_events_per_quantum"),
             directory_conflict_rounds=cfg.get_int("tpu/directory_conflict_rounds"),
+            rounds_per_quantum=cfg.get_int("tpu/rounds_per_quantum", 4),
             quanta_per_step=cfg.get_int("tpu/quanta_per_step"),
         )

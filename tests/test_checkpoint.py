@@ -1,0 +1,52 @@
+"""Checkpoint/resume: stop mid-simulation, restore, and finish with
+bit-identical results vs an uninterrupted run."""
+
+import numpy as np
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+
+def test_resume_bit_identical(tmp_path):
+    cfg = load_config()
+    cfg.set("general/total_cores", 8)
+    params = SimParams.from_config(cfg)
+    trace = synth.gen_private_mem(8, accesses=30, working_set_kb=4)
+
+    full = Simulator(params, trace)
+    s_full = full.run()
+
+    half = Simulator(params, trace)
+    half.run(max_steps=2)
+    ck = str(tmp_path / "ck.npz")
+    half.save_checkpoint(ck)
+
+    resumed = Simulator(params, trace)
+    resumed.restore_checkpoint(ck)
+    assert resumed.steps == 2
+    s_res = resumed.run()
+
+    assert s_full.completion_time_ps == s_res.completion_time_ps
+    for f, a in s_full.counters.items():
+        assert np.array_equal(a, s_res.counters[f]), f
+
+
+def test_checkpoint_shape_guard(tmp_path):
+    cfg = load_config()
+    cfg.set("general/total_cores", 8)
+    params = SimParams.from_config(cfg)
+    trace = synth.gen_private_mem(8, accesses=5, working_set_kb=4)
+    sim = Simulator(params, trace)
+    ck = str(tmp_path / "ck.npz")
+    sim.save_checkpoint(ck)
+
+    cfg2 = load_config()
+    cfg2.set("general/total_cores", 16)
+    params2 = SimParams.from_config(cfg2)
+    trace2 = synth.gen_private_mem(16, accesses=5, working_set_kb=4)
+    sim2 = Simulator(params2, trace2)
+    import pytest
+    with pytest.raises(ValueError):
+        sim2.restore_checkpoint(ck)
