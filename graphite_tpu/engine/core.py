@@ -70,7 +70,7 @@ def local_advance(params: SimParams, state: SimState,
     num_bars = state.bar_count.shape[0]
     mcp = mcp_tile(params)
 
-    def slot(st: SimState, _):
+    def slot(st: SimState):
         c = st.counters
         active = (~st.done) & (st.pend_kind == PEND_NONE) \
             & (st.clock < st.boundary) & (st.cursor < N)
@@ -308,8 +308,22 @@ def local_advance(params: SimParams, state: SimState,
             ch_time=ch_time,
             counters=c,
         )
-        return st, None
+        return st
 
-    state, _ = jax.lax.scan(slot, state, None,
-                            length=params.max_events_per_quantum)
+    # Early-exit event loop: identical slot semantics to a fixed-length
+    # scan, but iterations stop as soon as no tile can retire anything
+    # (all parked/done/at-boundary) — most of a quantum's slot budget goes
+    # unused whenever tiles wait on sync or memory, and skipping the no-op
+    # slots changes no timing.
+    def cond(carry):
+        i, st = carry
+        runnable = (~st.done) & (st.pend_kind == PEND_NONE) \
+            & (st.clock < st.boundary) & (st.cursor < N)
+        return (i < params.max_events_per_quantum) & runnable.any()
+
+    def body(carry):
+        i, st = carry
+        return i + 1, slot(st)
+
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
     return state

@@ -98,8 +98,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     dram_service_ps = jnp.int64(
         params.dram.processing_ps_per_line(params.line_size))
 
-    def round_body(_, carry):
-        state, resolved, line_floor = carry
+    def round_body(carry):
+        _i, state, resolved, line_floor = carry
         c = state.counters
         unres = is_req & ~resolved
 
@@ -314,11 +314,19 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                       t_free[None, :], 0), axis=1)
         line_floor = jnp.maximum(line_floor, floor_cand)
         resolved = resolved | win
-        return state, resolved, line_floor
+        return _i + 1, state, resolved, line_floor
 
-    carry = (state, jnp.zeros(T, dtype=bool), jnp.zeros(T, dtype=jnp.int64))
-    state, _, _ = jax.lax.fori_loop(
-        0, params.directory_conflict_rounds, round_body, carry)
+    # Early-exit conflict rounds: a round only runs while unresolved
+    # requests remain (identical results to the fixed-count loop — rounds
+    # with no unresolved requests elect no winners and change nothing).
+    def round_cond(carry):
+        i, _state, resolved, _floor = carry
+        return (i < params.directory_conflict_rounds) \
+            & (is_req & ~resolved).any()
+
+    carry = (jnp.int32(0), state, jnp.zeros(T, dtype=bool),
+             jnp.zeros(T, dtype=jnp.int64))
+    _, state, _, _ = jax.lax.while_loop(round_cond, round_body, carry)
     return state
 
 
@@ -432,11 +440,22 @@ def resolve_mutex(params: SimParams, state: SimState) -> SimState:
     return _unblock(state, win, completion, sync=True)
 
 
+def _when_pending(kind: int, fn, params: SimParams,
+                  state: SimState) -> SimState:
+    """Run a resolver only if some tile is parked on its pend kind —
+    `lax.cond` skips the resolver's gathers/scatters entirely otherwise
+    (a resolver sees only masked no-ops when nothing matches, so this is
+    result-identical)."""
+    return jax.lax.cond(
+        (state.pend_kind == kind).any(),
+        lambda s: fn(params, s), lambda s: s, state)
+
+
 def resolve(params: SimParams, state: SimState) -> SimState:
     """One full cross-tile resolution pass."""
     state = resolve_memory(params, state)
-    state = resolve_recv(params, state)
-    state = resolve_send(params, state)
-    state = resolve_barrier(params, state)
-    state = resolve_mutex(params, state)
+    state = _when_pending(PEND_RECV, resolve_recv, params, state)
+    state = _when_pending(PEND_SEND, resolve_send, params, state)
+    state = _when_pending(PEND_BARRIER, resolve_barrier, params, state)
+    state = _when_pending(PEND_MUTEX, resolve_mutex, params, state)
     return state
