@@ -24,7 +24,7 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 1
+_SCHEMA_VERSION = 2  # v2: freq_ghz float64 -> period_ps int32
 
 
 def _flatten_with_paths(state: SimState):

@@ -41,12 +41,13 @@ I, S, M = cachemod.I, cachemod.S, cachemod.M
 
 
 def _lat(cycles, period_ps):
-    """cycles (int/array) at a per-tile clock period -> int64 ps."""
-    return jnp.int64(jnp.round(cycles * period_ps))
+    """cycles (int/array) at an integer ps clock period -> int64 ps."""
+    return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)
 
 
 def _period(state: SimState, module: DVFSModule):
-    return 1000.0 / state.freq_ghz[:, int(module)]
+    """[T] int32 ps-per-cycle of a DVFS module's current clock."""
+    return state.period_ps[:, int(module)]
 
 
 def mcp_tile(params: SimParams) -> int:
@@ -189,10 +190,13 @@ def local_advance(params: SimParams, state: SimState,
         dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
         dt_dvfs = _lat(params.dvfs_sync_delay_cycles, p_core)
         mod_eff = jnp.where(is_dvfs,
-                            jnp.clip(arg, 0, state.freq_ghz.shape[1] - 1),
-                            state.freq_ghz.shape[1]).astype(jnp.int32)
-        freq_ghz = st.freq_ghz.at[rows, mod_eff].set(
-            jnp.maximum(arg2, 1) / 1000.0, mode="drop")
+                            jnp.clip(arg, 0, state.period_ps.shape[1] - 1),
+                            state.period_ps.shape[1]).astype(jnp.int32)
+        # arg2 carries the new frequency in MHz (schema dvfs_set);
+        # period_ps = round(1e6 / MHz).
+        mhz = jnp.maximum(arg2, 1)
+        period_ps = st.period_ps.at[rows, mod_eff].set(
+            ((1_000_000 + mhz // 2) // mhz).astype(jnp.int32), mode="drop")
 
         # ------------------------------------------------------ combine dt
         dt = jnp.zeros(T, dtype=jnp.int64)
@@ -299,7 +303,7 @@ def local_advance(params: SimParams, state: SimState,
             pend_extra=pend_extra,
             bp_table=bp_table,
             l1i=l1i, l1d=l1d, l2=l2,
-            freq_ghz=freq_ghz,
+            period_ps=period_ps,
             lock_holder=lock_holder,
             lock_free_at=lock_free_at,
             bar_count=bar_count,

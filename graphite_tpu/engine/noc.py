@@ -46,8 +46,8 @@ def unicast_ps(net: NetworkParams, src, dst, payload_bytes,
                period_ps, mesh_width: int):
     """Zero-load packet latency in ps.
 
-    ``period_ps``: float64 [K] — the network clock period of the sender's
-    DVFS domain (latencies scale with DVFS, reference:
+    ``period_ps``: int32 [K] — ps per cycle of the sender's network DVFS
+    domain (latencies scale with DVFS, reference:
     network_model.h DVFS recompute).
     """
     if net.model == "magic":
@@ -56,7 +56,7 @@ def unicast_ps(net: NetworkParams, src, dst, payload_bytes,
     flits = num_flits(payload_bytes, net.flit_width_bits)
     cycles = hops * (net.router_delay_cycles + net.link_delay_cycles) \
         + jnp.maximum(flits - 1, 0)
-    return jnp.int64(jnp.round(cycles * period_ps))
+    return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)
 
 
 def max_hop_to_mask_ps(net: NetworkParams, src, tile_mask,
@@ -78,4 +78,4 @@ def max_hop_to_mask_ps(net: NetworkParams, src, tile_mask,
     cycles = max_hops * (net.router_delay_cycles + net.link_delay_cycles) \
         + jnp.maximum(flits - 1, 0)
     cycles = jnp.where(tile_mask.any(axis=-1), cycles, 0)
-    return jnp.int64(jnp.round(cycles * period_ps))
+    return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)

@@ -41,22 +41,35 @@ class FcfsResult(NamedTuple):
 def _segmented_running_max(x: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
     """Running max of ``x`` that restarts at every True in ``seg_start``.
 
-    Implemented as a global running max of (x - offset) trickery-free form:
-    use a prefix-max where segment starts inject -inf barriers via a
-    two-pass approach: running max of ``where(seg_start, -inf, x)`` does not
-    work directly, so we use the standard trick of maxing x with a running
-    'segment id floor': compute segment ids, then take the cummax of
-    (segment_id * LARGE + normalized x) — safe here because x is int64 time
-    bounded well below 2**52 and segment ids fit 11 bits.
+    Hillis-Steele doubling over (value, is_start) pairs: log2(K) rounds of
+    shift + elementwise combine.  Written with explicit shifts rather than
+    ``lax.associative_scan``/``jnp.cumsum`` because XLA:TPU lowers int64
+    scans to reduce-windows whose scoped-VMEM footprint blows past the
+    16 MB limit at K >= 256; the doubling form stays elementwise.
     """
-    # Robust approach: associative scan over (value, is_start) pairs.
-    def combine(a, b):
-        av, astart = a
-        bv, bstart = b
-        v = jnp.where(bstart, bv, jnp.maximum(av, bv))
-        return v, astart | bstart
+    neg = jnp.int64(-(2**62))
+    v, st = x, seg_start
+    d = 1
+    K = x.shape[0]
+    while d < K:
+        pv = jnp.concatenate([jnp.full((d,), neg, x.dtype), v[:-d]])
+        ps = jnp.concatenate([jnp.ones((d,), bool), st[:-d]])
+        v = jnp.where(st, v, jnp.maximum(v, pv))
+        st = st | ps
+        d *= 2
+    return v
 
-    v, _ = jax.lax.associative_scan(combine, (x, seg_start))
+
+def _cumsum_doubling(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive prefix sum via doubling (same TPU-lowering rationale as
+    ``_segmented_running_max``)."""
+    v = x
+    d = 1
+    K = x.shape[0]
+    while d < K:
+        pv = jnp.concatenate([jnp.zeros((d,), x.dtype), v[:-d]])
+        v = v + pv
+        d *= 2
     return v
 
 
@@ -84,7 +97,7 @@ def fcfs(resource: jnp.ndarray, arrival: jnp.ndarray, service: jnp.ndarray,
     seg_start = jnp.concatenate(
         [jnp.ones(1, dtype=bool), r_s[1:] != r_s[:-1]])
     # Prefix sums of service, exclusive within segment.
-    cs = jnp.cumsum(sv_s)
+    cs = _cumsum_doubling(sv_s)
     seg_base = _segmented_running_max(
         jnp.where(seg_start, cs - sv_s, jnp.int64(-(2**62))), seg_start)
     S_prev = (cs - sv_s) - seg_base          # segment-local exclusive prefix

@@ -126,8 +126,11 @@ class SimState(NamedTuple):
     l1d: cachemod.CacheArrays
     l2: cachemod.CacheArrays
 
-    # -- DVFS module frequencies (reference: dvfs_manager.h:19-88)
-    freq_ghz: jnp.ndarray     # [T, NUM_DVFS_MODULES] float64
+    # -- DVFS module clock periods (reference: dvfs_manager.h:19-88 keeps
+    # per-module frequencies; the engine stores the derived integer period
+    # so the hot loops never touch floating point — float64 is emulated on
+    # TPU and was the single largest per-slot cost)
+    period_ps: jnp.ndarray    # [T, NUM_DVFS_MODULES] int32 ps per cycle
 
     # -- directory slices (home-tile-indexed; reference: directory_cache.cc)
     dir_tags: jnp.ndarray     # [T, dsets, dassoc] int64 line
@@ -153,11 +156,11 @@ class SimState(NamedTuple):
     counters: Counters
 
 
-def init_freq(params: SimParams) -> np.ndarray:
-    f = np.zeros((params.num_tiles, NUM_DVFS_MODULES), dtype=np.float64)
+def init_periods(params: SimParams) -> np.ndarray:
+    p = np.zeros((params.num_tiles, NUM_DVFS_MODULES), dtype=np.int32)
     for m in DVFSModule:
-        f[:, int(m)] = params.module_freq_ghz(m)
-    return f
+        p[:, int(m)] = int(round(1000.0 / params.module_freq_ghz(m)))
+    return p
 
 
 def make_state(params: SimParams,
@@ -181,7 +184,7 @@ def make_state(params: SimParams,
         l1i=cachemod.make_cache(T, params.l1i),
         l1d=cachemod.make_cache(T, params.l1d),
         l2=cachemod.make_cache(T, params.l2),
-        freq_ghz=jnp.asarray(init_freq(params)),
+        period_ps=jnp.asarray(init_periods(params)),
         dir_tags=jnp.zeros(d_shape, dtype=jnp.int64),
         dir_state=jnp.zeros(d_shape, dtype=jnp.int32),
         dir_owner=jnp.full(d_shape, -1, dtype=jnp.int32),

@@ -25,18 +25,20 @@ TIME_MAX = np.int64(2**62)
 
 
 def cycles_to_ps(cycles, freq_ghz):
-    """Convert a cycle count at ``freq_ghz`` to int64 picoseconds.
+    """Host-side: cycle count at ``freq_ghz`` -> int64 picoseconds.
 
-    ps = cycles * 1000 / freq_ghz, rounded to nearest (reference converts
-    through double ns; we keep float64 which is exact for all practical
-    cycle counts < 2**52).
+    cycles * period_ps(freq) with the same integer period the engine
+    stores (state.period_ps) — device code multiplies integer periods
+    directly and never sees floats.
     """
-    return jnp.int64(jnp.round(jnp.float64(cycles) * (PS_PER_NS / 1.0) / jnp.float64(freq_ghz)))
+    return np.int64(cycles) * np.int64(round(PS_PER_NS / float(freq_ghz)))
 
 
 def ps_to_cycles(ps, freq_ghz):
-    """Convert int64 picoseconds to a cycle count at ``freq_ghz`` (rounded)."""
-    return jnp.int64(jnp.round(jnp.float64(ps) * jnp.float64(freq_ghz) / PS_PER_NS))
+    """Host-side: int64 picoseconds -> cycle count at ``freq_ghz``
+    (rounded against the engine's integer period)."""
+    p = np.int64(round(PS_PER_NS / float(freq_ghz)))
+    return np.int64((np.int64(ps) + p // 2) // p)
 
 
 def ns_to_ps(ns) -> np.int64:
@@ -47,6 +49,7 @@ def ps_to_ns(ps) -> float:
     return float(ps) / PS_PER_NS
 
 
-def period_ps(freq_ghz) -> float:
-    """Picoseconds per cycle at ``freq_ghz`` (float; multiply then round)."""
-    return PS_PER_NS / float(freq_ghz)
+def period_ps(freq_ghz) -> int:
+    """Integer picoseconds per cycle at ``freq_ghz`` — the engine's clock
+    convention (state.period_ps stores exactly this value per module)."""
+    return int(round(PS_PER_NS / float(freq_ghz)))
