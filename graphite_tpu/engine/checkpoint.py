@@ -24,7 +24,7 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 2  # v2: freq_ghz float64 -> period_ps int32
+_SCHEMA_VERSION = 3  # v2: freq_ghz -> period_ps; v3: dir_deferrals counter
 
 
 def _flatten_with_paths(state: SimState):

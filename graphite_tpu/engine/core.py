@@ -151,8 +151,12 @@ def local_advance(params: SimParams, state: SimState,
         send_net_ps = noc.unicast_ps(
             params.net_user, rows, dst, jnp.maximum(arg, 0), p_nu,
             params.mesh_width)
-        arrival = st.clock + cycle_ps + send_net_ps
         slot_idx = st.ch_sent[rows, dst] % chan_depth
+        # The reused ring slot holds the consuming recv's completion time
+        # (written by resolve_recv): even when the count check shows space,
+        # the message can't occupy the slot before the recv that freed it.
+        slot_freed = st.ch_time[rows, dst, slot_idx]
+        arrival = jnp.maximum(st.clock + cycle_ps, slot_freed) + send_net_ps
         src_eff = jnp.where(is_send, rows, T).astype(jnp.int32)
         ch_time = st.ch_time.at[src_eff, dst, slot_idx].set(
             arrival, mode="drop")

@@ -51,6 +51,32 @@ def home_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
     return ((line % n) * params.dram.controller_home_stride).astype(jnp.int32)
 
 
+_BIG = jnp.int64(2**62)
+
+
+def _fcfs_keys(active, issue) -> jnp.ndarray:
+    """Per-row FCFS key ordered by (issue, tile), unique per row.
+
+    Issue times are rebased to the earliest active row so the key stays far
+    below the ``_BIG`` empty-slot sentinel at any simulated time (skew
+    within one resolve pass is bounded by quantum + max latency, nowhere
+    near the 2^40 clip).
+    """
+    T = issue.shape[0]
+    rows = jnp.arange(T)
+    issue0 = jnp.min(jnp.where(active, issue, _BIG))
+    return jnp.clip(issue - issue0, 0, jnp.int64(2**40)) * T + rows
+
+
+def _elect(active, packed, idx, size):
+    """Scatter-min FCFS election: the earliest active row per ``idx`` value
+    wins (one winner per table slot; a hash collision between two distinct
+    keys mapping to one slot only defers the later row)."""
+    tbl = jnp.full((size,), _BIG, dtype=jnp.int64).at[
+        jnp.where(active, idx, size)].min(packed, mode="drop")
+    return active & (tbl[idx] == packed)
+
+
 def _unblock(state: SimState, mask, completion, sync: bool) -> SimState:
     c = state.counters
     stall = jnp.where(mask, completion - state.pend_issue, 0)
@@ -69,12 +95,31 @@ def _unblock(state: SimState, mask, completion, sync: bool) -> SimState:
 # ===================================================================== memory
 
 def resolve_memory(params: SimParams, state: SimState) -> SimState:
+    """Serve all parked L2-miss requests through the home directories.
+
+    Work per conflict round is O(T) + O(budget x T): same-line FCFS
+    election and the per-line serialization floor go through scatter-min/max
+    hash tables instead of [T, T] comparison matrices, and invalidation
+    fan-out (EX-on-S sharer invalidations + shared-victim directory-entry
+    evictions) is delivered for at most ``max_inv_fanout_per_round``
+    requests per round — the rest defer to the next round (FCFS order
+    preserved: a deferred winner re-wins its line next round), counted in
+    ``dir_deferrals``.  A hash collision between two different pending
+    lines only over-serializes (the loser retries next round); it never
+    mis-times a request.
+    """
     T = params.num_tiles
     W = state.dir_sharers.shape[-1]
     A = params.directory.associativity
+    K = min(params.max_inv_fanout_per_round, T)
+    # Election hash-table size: with up to T concurrent distinct keys the
+    # expected number of colliding pairs is ~T^2/2H; 64x keeps spurious
+    # one-round deferrals rare (<1% of requests) at 8 bytes/slot.
+    H = max(4096, 64 * T)
     rows = jnp.arange(T)
     line_bits = params.line_size.bit_length() - 1
     nctl = params.dram.num_controllers
+    ndsets = params.directory.num_sets
 
     is_req = ((state.pend_kind == PEND_SH_REQ)
               | (state.pend_kind == PEND_EX_REQ)
@@ -83,8 +128,10 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     is_ex = state.pend_kind == PEND_EX_REQ
     is_if = state.pend_kind == PEND_IFETCH
     home = home_of_line(params, line)
-    dset = ((line // nctl) % params.directory.num_sets).astype(jnp.int32)
+    dset = ((line // nctl) % ndsets).astype(jnp.int32)
     issue = state.pend_issue
+    packed = _fcfs_keys(is_req, issue)
+    hidx = (line % H).astype(jnp.int32)
 
     # Per-tile clock periods.
     p_net = _period(state, DVFSModule.NETWORK_MEMORY)
@@ -97,19 +144,16 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     dram_access_ps = jnp.int64(params.dram.latency_ps)
     dram_service_ps = jnp.int64(
         params.dram.processing_ps_per_line(params.line_size))
+    flits_req = noc.num_flits(CTRL_BYTES, params.net_memory.flit_width_bits)
+    flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
+                               params.net_memory.flit_width_bits)
 
     def round_body(carry):
         _i, state, resolved, line_floor = carry
-        c = state.counters
         unres = is_req & ~resolved
 
         # ---- earliest-per-line election (the directory FSM serialization)
-        same = (line[:, None] == line[None, :]) \
-            & unres[:, None] & unres[None, :]
-        earlier = (issue[None, :] < issue[:, None]) \
-            | ((issue[None, :] == issue[:, None])
-               & (rows[None, :] < rows[:, None]))
-        win = unres & ~(same & earlier).any(axis=1)
+        win = _elect(unres, packed, hidx, H)
 
         # ---- directory-cache probe at (home, dset)
         dtags = state.dir_tags[home, dset]      # [T, A]
@@ -119,10 +163,31 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         hway = jnp.argmax(match, axis=1).astype(jnp.int32)
         dlru = state.dir_lru[home, dset]
         invalid = dstate == I
-        alloc_way = jnp.where(invalid.any(axis=1),
-                              jnp.argmax(invalid, axis=1),
+        # Allocating requests spread over the set's invalid ways by
+        # requester id (different tiles cold-missing into the same home set
+        # — the common case under tile-symmetric address layouts — install
+        # in parallel instead of re-computing one identical alloc_way).
+        n_inv = jnp.sum(invalid, axis=1).astype(jnp.int32)
+        kth = (rows % jnp.maximum(n_inv, 1)).astype(jnp.int32)
+        inv_rank = jnp.cumsum(invalid.astype(jnp.int32), axis=1)
+        kth_invalid = jnp.argmax(
+            invalid & (inv_rank == (kth + 1)[:, None]), axis=1)
+        alloc_way = jnp.where(n_inv > 0, kth_invalid,
                               jnp.argmax(dlru, axis=1)).astype(jnp.int32)
         way = jnp.where(hit, hway, alloc_way)
+
+        # ---- way-slot election: at most one winner per (home, dset, way)
+        # per round.  A miss installing into a way that another winner (a
+        # hit re-reading it, or another miss allocating it) touches in the
+        # same round would silently lose a directory entry; all winners
+        # compete for their way slot and losers defer a round.  (Two *hit*
+        # winners can never collide: a way holds one tag and the per-line
+        # election already picked one winner for it.)
+        aidx = (((home.astype(jnp.int64) * ndsets + dset) * A + way)
+                % H).astype(jnp.int32)
+        alloc_defer = win & ~_elect(win, packed, aidx, H)
+        win = win & ~alloc_defer
+
         evicting = win & ~hit & ~invalid.any(axis=1)
 
         entry_state = jnp.where(
@@ -138,15 +203,88 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 axis=1)[:, 0, :],
             jnp.zeros((T, W), dtype=jnp.uint64))
 
+        # Victim directory entry being replaced (reference invalidates all
+        # of the victim's sharers/owner on directory-cache replacement —
+        # dram_directory_cntlr replacement path; leaving them cached would
+        # let a later request grant M while stale copies still hit).
+        vtag = jnp.take_along_axis(dtags, alloc_way[:, None], axis=1)[:, 0]
+        vstate = jnp.where(
+            evicting,
+            jnp.take_along_axis(dstate, alloc_way[:, None], axis=1)[:, 0], I)
+        vowner = jnp.take_along_axis(
+            state.dir_owner[home, dset], alloc_way[:, None], axis=1)[:, 0]
+        vsharers = jnp.take_along_axis(
+            state.dir_sharers[home, dset], alloc_way[:, None, None],
+            axis=1)[:, 0, :]
+        evict_m = evicting & (vstate == M) & (vowner >= 0)
+        # Empty-S entries (every sharer already dropped the line silently)
+        # need no invalidation traffic — don't burn a fan-out slot on them.
+        evict_s = evicting & (vstate == S) \
+            & (vsharers != jnp.uint64(0)).any(axis=1)
+
         act = dirmod.msi_transition(is_ex, rows, entry_state, entry_owner,
                                     entry_sharers, W)
+        has_inv = win & (act.inv_targets != jnp.uint64(0)).any(axis=1)
+
+        # ---- fan-out budget: at most K multicast deliveries per round,
+        # granted in FCFS key order (not tile order) so a hot-spot round
+        # never systematically favors low tile ids.
+        need_fan = has_inv | evict_s
+        fan_keys = jnp.where(need_fan, packed, _BIG)
+        kth = -jax.lax.top_k(-fan_keys, K)[0][K - 1]   # Kth-smallest key
+        sel = need_fan & (packed <= kth)
+        rank = queue_models._cumsum_doubling(sel.astype(jnp.int32)) - 1
+        fan_defer = need_fan & ~sel
+        win = win & ~fan_defer
+        has_inv = has_inv & ~fan_defer
+        evict_m = evict_m & ~fan_defer
+        evict_s = evict_s & ~fan_defer
+        evicting = evicting & ~fan_defer
+
+        # Selected fan-out rows gathered into [K] slots.
+        sel_slot = jnp.where(sel, rank, K)
+        sel_rows = jnp.full((K,), T, dtype=jnp.int32).at[sel_slot].set(
+            rows.astype(jnp.int32), mode="drop")
+        sel_ok = sel_rows < T
+        sr = jnp.minimum(sel_rows, T - 1)
+
+        inv_words = act.inv_targets[sr] & jnp.where(
+            (sel_ok & has_inv[sr])[:, None], ~jnp.uint64(0), jnp.uint64(0))
+        vic_words = vsharers[sr] & jnp.where(
+            (sel_ok & evict_s[sr])[:, None], ~jnp.uint64(0), jnp.uint64(0))
+        inv_bool = dirmod.bitmap_to_bool(inv_words, T)   # [K, T]
+        vic_bool = dirmod.bitmap_to_bool(vic_words, T)   # [K, T]
+
+        # Invalidation round-trip latencies, scattered back per requester.
+        inv_ps_k = 2 * noc.max_hop_to_mask_ps(
+            params.net_memory, home[sr], inv_bool, CTRL_BYTES,
+            p_net[home[sr]], params.mesh_width) + cycle_ps[sr]
+        vic_ps_k = 2 * noc.max_hop_to_mask_ps(
+            params.net_memory, home[sr], vic_bool, CTRL_BYTES,
+            p_net[home[sr]], params.mesh_width) + cycle_ps[sr]
+        inv_ps = jnp.zeros(T, dtype=jnp.int64).at[sel_rows].set(
+            jnp.where(sel_ok & has_inv[sr], inv_ps_k, 0), mode="drop")
+        evict_ps = jnp.zeros(T, dtype=jnp.int64).at[sel_rows].set(
+            jnp.where(sel_ok & evict_s[sr], vic_ps_k, 0), mode="drop")
+        # M-state victim: single-owner flush round trip.
+        evict_m_ps = noc.unicast_ps(
+            params.net_memory, home, jnp.maximum(vowner, 0), CTRL_BYTES,
+            p_net, params.mesh_width) \
+            + _lat(params.l2.access_cycles, p_l2[jnp.maximum(vowner, 0)]) \
+            + noc.unicast_ps(
+                params.net_memory, jnp.maximum(vowner, 0), home,
+                params.line_size + CTRL_BYTES,
+                p_net[jnp.maximum(vowner, 0)], params.mesh_width)
+        evict_ps = jnp.where(evict_m, evict_m_ps, evict_ps)
 
         # ---- latency assembly (SURVEY.md 3.3's round trips, analytically)
         net_req = noc.unicast_ps(params.net_memory, rows, home, CTRL_BYTES,
                                  p_net, params.mesh_width)
         arrive = jnp.maximum(issue + net_req, line_floor)
         dir_ps = _lat(params.directory.access_cycles, p_dir[home])
-        t_dir = arrive + dir_ps
+        # Replacement of a live victim entry completes before the new
+        # request is served.
+        t_dir = arrive + dir_ps + jnp.where(evicting, evict_ps, 0)
 
         owner = act.owner_tile
         owner_leg = act.owner_leg & win
@@ -158,15 +296,6 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                              params.mesh_width)
         owner_ps = jnp.where(owner_leg, leg_ps, 0)
 
-        inv_bool = dirmod.bitmap_to_bool(act.inv_targets, T)  # [Treq, Ttgt]
-        inv_bool = inv_bool & win[:, None]
-        has_inv = inv_bool.any(axis=1)
-        inv_ps = jnp.where(
-            has_inv,
-            2 * noc.max_hop_to_mask_ps(params.net_memory, home, inv_bool,
-                                       CTRL_BYTES, p_net[home],
-                                       params.mesh_width) + cycle_ps, 0)
-
         need_read = win & act.dram_read
         dram_arrival = t_dir + owner_ps
         q = queue_models.fcfs(home, dram_arrival,
@@ -174,10 +303,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                               state.dram_free_at)
         dram_ready = q.start + dram_access_ps + dram_service_ps
         state = state._replace(dram_free_at=q.free_at)
-        # Writebacks from an owner leg occupy the controller off the
-        # critical path (write buffer): add occupancy only.
+        # Writebacks (owner-leg flushes, dirty victim evictions) occupy the
+        # controller off the critical path (write buffer): occupancy only.
         state = state._replace(dram_free_at=state.dram_free_at.at[
-            jnp.where(owner_leg, home, T)].add(dram_service_ps, mode="drop"))
+            jnp.where(owner_leg | evict_m, home, T)].add(
+                dram_service_ps, mode="drop"))
 
         t_data = t_dir + owner_ps
         t_data = jnp.maximum(t_data, jnp.where(need_read, dram_ready, 0))
@@ -207,7 +337,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 act.new_sharers, mode="drop"),
         )
         # Dir LRU: promote the touched way (whole-row scatter; colliding
-        # same-set winners resolve arbitrarily — bounded inaccuracy).
+        # same-set hit winners resolve arbitrarily — bounded inaccuracy).
         r_w = jnp.take_along_axis(dlru, way[:, None], axis=1)
         promoted = jnp.where(jnp.arange(A)[None, :] == way[:, None], 0,
                              dlru + (dlru < r_w))
@@ -215,22 +345,33 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             dir_lru=state.dir_lru.at[home_w, dset].set(
                 jnp.where(win[:, None], promoted, dlru), mode="drop"))
 
-        # ---- owner downgrade / sharer invalidation scatters
-        pair_valid = owner_leg
-        pairs = jnp.stack(
-            [owner.astype(jnp.int64), line], axis=1)
+        # ---- owner downgrade (current-entry M) + victim-owner flush
+        pairs = jnp.concatenate([
+            jnp.stack([owner.astype(jnp.int64), line], axis=1),
+            jnp.stack([jnp.maximum(vowner, 0).astype(jnp.int64), vtag],
+                      axis=1)], axis=0)
+        pvalid = jnp.concatenate([owner_leg, evict_m], axis=0)
+        pdown = jnp.concatenate(
+            [act.owner_downgrade_to, jnp.full(T, I, dtype=jnp.int32)],
+            axis=0)
         l2c, _ = cachemod.invalidate_lines(
-            state.l2, pairs, pair_valid, params.l2.num_sets,
-            act.owner_downgrade_to)
+            state.l2, pairs, pvalid, params.l2.num_sets, pdown)
         l1c, _ = cachemod.invalidate_lines(
-            state.l1d, pairs, pair_valid, params.l1d.num_sets,
-            act.owner_downgrade_to)
+            state.l1d, pairs, pvalid, params.l1d.num_sets, pdown)
         state = state._replace(l2=l2c, l1d=l1c)
 
-        tgt = jnp.broadcast_to(rows[None, :], (T, T)).reshape(-1)
-        lin = jnp.broadcast_to(line[:, None], (T, T)).reshape(-1)
-        ipairs = jnp.stack([tgt.astype(jnp.int64), lin], axis=1)
-        ivalid = inv_bool.reshape(-1)
+        # ---- budgeted sharer invalidations: line-inv + victim-evict pairs
+        ktgt = jnp.broadcast_to(rows[None, :], (K, T))
+        ipairs = jnp.concatenate([
+            jnp.stack([ktgt.reshape(-1).astype(jnp.int64),
+                       jnp.broadcast_to(line[sr][:, None],
+                                        (K, T)).reshape(-1)], axis=1),
+            jnp.stack([ktgt.reshape(-1).astype(jnp.int64),
+                       jnp.broadcast_to(vtag[sr][:, None],
+                                        (K, T)).reshape(-1)], axis=1),
+        ], axis=0)
+        ivalid = jnp.concatenate(
+            [inv_bool.reshape(-1), vic_bool.reshape(-1)], axis=0)
         l2c, _ = cachemod.invalidate_lines(
             state.l2, ipairs, ivalid, params.l2.num_sets, I)
         l1c, _ = cachemod.invalidate_lines(
@@ -243,6 +384,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                            win, params.l2.num_sets, params.l2.replacement)
         state = state._replace(l2=f2.cache)
         victim_dirty = win & (f2.victim_state == M)
+        victim_live = win & (f2.victim_state != I)
         victim_home = home_of_line(params, f2.victim_tag)
         state = state._replace(dram_free_at=state.dram_free_at.at[
             jnp.where(victim_dirty, victim_home, T)].add(
@@ -251,9 +393,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # reference l2_cache_cntlr invalidation of L1 on eviction).
         vpairs = jnp.stack([rows.astype(jnp.int64), f2.victim_tag], axis=1)
         l1c, _ = cachemod.invalidate_lines(
-            state.l1d, vpairs, win & (f2.victim_state != I),
-            params.l1d.num_sets, I)
+            state.l1d, vpairs, victim_live, params.l1d.num_sets, I)
         state = state._replace(l1d=l1c)
+        # Notify the victim line's home directory (reference sends eviction
+        # writebacks that downgrade the entry; silently dropping them left
+        # stale owners/sharer bits that charge phantom coherence legs).
+        # Off the requester's critical path.
+        state = _dir_evict_notify(params, state, rows, f2.victim_tag,
+                                  f2.victim_state, victim_live)
 
         fd = cachemod.fill(state.l1d, line,
                            jnp.where(is_ex, M, S).astype(jnp.int32),
@@ -270,22 +417,22 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         def sadd(arr, idx, mask, val=1):
             return arr.at[jnp.where(mask, idx, T)].add(val, mode="drop")
 
-        inv_count = jnp.where(win, jnp.sum(inv_bool, axis=1), 0)
-        flits_req = noc.num_flits(CTRL_BYTES,
-                                  params.net_memory.flit_width_bits)
-        flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
-                                   params.net_memory.flit_width_bits)
+        inv_count = jnp.zeros(T, dtype=jnp.int64).at[sel_rows].add(
+            jnp.where(sel_ok,
+                      jnp.sum(inv_bool, axis=1) + jnp.sum(vic_bool, axis=1),
+                      0).astype(jnp.int64), mode="drop")
         c = state.counters
         c = c._replace(
             dir_sh_req=sadd(c.dir_sh_req, home, win & ~is_ex),
             dir_ex_req=sadd(c.dir_ex_req, home, win & is_ex),
             dir_invalidations=sadd(c.dir_invalidations, home,
                                    inv_count > 0, inv_count),
-            dir_writebacks=sadd(c.dir_writebacks, home, owner_leg),
+            dir_writebacks=sadd(c.dir_writebacks, home,
+                                owner_leg | evict_m),
             dir_evictions=sadd(c.dir_evictions, home, evicting),
             dram_reads=sadd(c.dram_reads, home, need_read),
             dram_writes=sadd(
-                sadd(c.dram_writes, home, owner_leg),
+                sadd(c.dram_writes, home, owner_leg | evict_m),
                 victim_home, victim_dirty),
             net_mem_pkts=c.net_mem_pkts
             + jnp.where(win, 1, 0)                    # request
@@ -303,16 +450,27 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 sadd(c.net_mem_flits, home, win, flits_data),
                 home, inv_count > 0, inv_count * flits_req),
         )
+        # Deferral events this round: way-slot collisions + fan-out budget
+        # overflow (a request deferred in N rounds counts N times; end-of-
+        # pass saturation is counted separately below).
+        c = c._replace(
+            dir_deferrals=sadd(c.dir_deferrals, home,
+                               alloc_defer | fan_defer))
         state = state._replace(counters=c)
 
         state = _unblock(state, win, completion, sync=False)
 
-        # ---- serialization floor for still-pending same-line requests
+        # ---- serialization floor for still-pending same-line requests:
+        # per-line winner's data-availability time, via the same hash table
+        # (a stored-line check makes collisions inert).
         t_free = t_data
-        floor_cand = jnp.max(
-            jnp.where((line[:, None] == line[None, :]) & win[None, :],
-                      t_free[None, :], 0), axis=1)
-        line_floor = jnp.maximum(line_floor, floor_cand)
+        ftbl_line = jnp.full((H,), -1, dtype=jnp.int64).at[
+            jnp.where(win, hidx, H)].set(line, mode="drop")
+        ftbl_t = jnp.zeros((H,), dtype=jnp.int64).at[
+            jnp.where(win, hidx, H)].max(t_free, mode="drop")
+        line_floor = jnp.maximum(
+            line_floor,
+            jnp.where(ftbl_line[hidx] == line, ftbl_t[hidx], 0))
         resolved = resolved | win
         return _i + 1, state, resolved, line_floor
 
@@ -326,7 +484,69 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
 
     carry = (jnp.int32(0), state, jnp.zeros(T, dtype=bool),
              jnp.zeros(T, dtype=jnp.int64))
-    _, state, _, _ = jax.lax.while_loop(round_cond, round_body, carry)
+    _, state, resolved, _ = jax.lax.while_loop(round_cond, round_body, carry)
+    # Saturation visibility (VERDICT weak #5): requests still parked after a
+    # full resolve pass slipped past the conflict-round budget and will be
+    # retried next sub-round.
+    saturated = is_req & ~resolved
+    c = state.counters
+    state = state._replace(counters=c._replace(
+        dir_deferrals=c.dir_deferrals.at[
+            jnp.where(saturated, home, T)].add(1, mode="drop")))
+    return state
+
+
+def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
+                      vstate, valid) -> SimState:
+    """Tell the home directory a tile silently dropped ``vtag`` from its L2.
+
+    M-owner entries become I (the dirty data went to DRAM); the tile's
+    sharer bit clears via a commutative subtract so concurrent drops of
+    different sharers of the same line all land.  (Reference: eviction
+    writeback messages into dram_directory_cntlr.)
+    """
+    T = params.num_tiles
+    W = state.dir_sharers.shape[-1]
+    nctl = params.dram.num_controllers
+    vhome = home_of_line(params, vtag)
+    vdset = ((vtag // nctl) % params.directory.num_sets).astype(jnp.int32)
+    dtags = state.dir_tags[vhome, vdset]        # [T, A]
+    dstate = state.dir_state[vhome, vdset]
+    match = (dtags == vtag[:, None]) & (dstate != I) & valid[:, None]
+    found = match.any(axis=1)
+    way = jnp.argmax(match, axis=1).astype(jnp.int32)
+    est = jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0]
+    eowner = jnp.take_along_axis(
+        state.dir_owner[vhome, vdset], way[:, None], axis=1)[:, 0]
+
+    # Owner dropped its M line: entry -> I.
+    drop_m = found & (est == M) & (eowner == tiles)
+    hm = jnp.where(drop_m, vhome, T).astype(jnp.int32)
+    state = state._replace(
+        dir_state=state.dir_state.at[hm, vdset, way].set(I, mode="drop"),
+        dir_owner=state.dir_owner.at[hm, vdset, way].set(-1, mode="drop"),
+        dir_sharers=state.dir_sharers.at[hm, vdset, way].set(
+            jnp.zeros((T, W), dtype=jnp.uint64), mode="drop"))
+
+    # Sharer dropped its S line: clear its bit (subtract — commutative, so
+    # distinct sharers of one entry may clear in the same batch).
+    word = (tiles // 64).astype(jnp.int32)
+    bit = jnp.uint64(1) << (tiles % 64).astype(jnp.uint64)
+    cur = state.dir_sharers[vhome, vdset, way, word]
+    drop_s = found & (est == S) & ((cur & bit) != jnp.uint64(0))
+    hs = jnp.where(drop_s, vhome, T).astype(jnp.int32)
+    state = state._replace(
+        dir_sharers=state.dir_sharers.at[hs, vdset, way, word].add(
+            jnp.uint64(0) - bit, mode="drop"))
+    # Last sharer gone -> entry I, so later evictions of the entry don't
+    # burn fan-out budget on an empty bitmap.  (Concurrent same-entry drops
+    # in one batch may leave a transient empty-S entry; the evict_s gate
+    # tolerates that.)
+    vsh = state.dir_sharers[vhome, vdset, way]          # [T, W]
+    empty = (vsh == jnp.uint64(0)).all(axis=1)
+    hz = jnp.where(drop_s & empty, vhome, T).astype(jnp.int32)
+    state = state._replace(
+        dir_state=state.dir_state.at[hz, vdset, way].set(I, mode="drop"))
     return state
 
 
@@ -349,6 +569,12 @@ def resolve_recv(params: SimParams, state: SimState) -> SimState:
     src_eff = jnp.where(ok, src, T)
     state = state._replace(
         ch_recvd=state.ch_recvd.at[src_eff, rows].add(1, mode="drop"),
+        # Overwrite the consumed ring slot with the recv's completion time:
+        # the slot's next writer (a send reusing it after a wrap) reads it
+        # back as the slot-freed floor, so back-pressured sends can never
+        # stamp arrivals that predate the recv that made room.
+        ch_time=state.ch_time.at[src_eff, rows, slot].set(
+            completion, mode="drop"),
         counters=state.counters._replace(
             recvs=state.counters.recvs + jnp.where(ok, 1, 0)))
     return _unblock(state, ok, completion, sync=True)
@@ -367,9 +593,13 @@ def resolve_send(params: SimParams, state: SimState) -> SimState:
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
     net_ps = noc.unicast_ps(params.net_user, rows, dst, state.pend_addr,
                             p_nu, params.mesh_width)
-    completion = state.pend_issue + cycle_ps
-    arrival = completion + net_ps
     slot = state.ch_sent[rows, dst] % D
+    # Floor at the time the reused ring slot was actually freed (the
+    # consuming recv's completion, stored into the slot by resolve_recv) —
+    # a back-pressured send cannot complete before the recv that made room.
+    freed = state.ch_time[rows, dst, slot]
+    completion = jnp.maximum(state.pend_issue, freed) + cycle_ps
+    arrival = completion + net_ps
     src_eff = jnp.where(ok, rows, T).astype(jnp.int32)
     state = state._replace(
         ch_time=state.ch_time.at[src_eff, dst, slot].set(arrival, mode="drop"),
@@ -414,11 +644,9 @@ def resolve_mutex(params: SimParams, state: SimState) -> SimState:
     lid = jnp.clip(state.pend_addr, 0, NL - 1).astype(jnp.int32)
     issue = state.pend_issue
     # FCFS: earliest waiter per free lock wins (SimMutex wakeup order,
-    # sync_server.cc).
-    same = (lid[:, None] == lid[None, :]) & is_mx[:, None] & is_mx[None, :]
-    earlier = (issue[None, :] < issue[:, None]) \
-        | ((issue[None, :] == issue[:, None]) & (rows[None, :] < rows[:, None]))
-    first = is_mx & ~(same & earlier).any(axis=1)
+    # sync_server.cc) — exact election: the lock id indexes the table
+    # directly, so there are no hash collisions.
+    first = _elect(is_mx, _fcfs_keys(is_mx, issue), lid, NL)
     free = state.lock_holder[lid] == 0
     win = first & free
     p_nu = _period(state, DVFSModule.NETWORK_USER)

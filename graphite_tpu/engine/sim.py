@@ -105,6 +105,7 @@ class SimSummary:
         row("Invalidations", agg["dir_invalidations"])
         row("Writebacks", agg["dir_writebacks"])
         row("Evictions", agg["dir_evictions"])
+        row("Conflict-Round Deferrals", agg["dir_deferrals"])
         lines.append("[dram]")
         row("Reads", agg["dram_reads"])
         row("Writes", agg["dram_writes"])

@@ -63,6 +63,10 @@ class Counters(NamedTuple):
     dir_invalidations: jnp.ndarray   # INV_REQ messages sent from this slice
     dir_writebacks: jnp.ndarray      # WB/FLUSH data returns to this slice
     dir_evictions: jnp.ndarray       # directory-cache entry evictions
+    dir_deferrals: jnp.ndarray       # deferral events: one per round a
+    #   request is pushed back by the way-slot election or the fan-out
+    #   budget, plus one per request still unresolved after a full resolve
+    #   pass (visibility into hot-line saturation)
     dram_reads: jnp.ndarray          # at this tile's memory controller
     dram_writes: jnp.ndarray
     net_mem_pkts: jnp.ndarray        # memory-network packets this tile sent
@@ -166,8 +170,10 @@ def init_periods(params: SimParams) -> np.ndarray:
 def make_state(params: SimParams,
                max_mutexes: int = 64,
                max_barriers: int = 16,
-               channel_depth: int = 16) -> SimState:
+               channel_depth: int = 0) -> SimState:
     T = params.num_tiles
+    if channel_depth <= 0:
+        channel_depth = params.channel_depth
     d_shape = (T, params.directory.num_sets, params.directory.associativity)
     W = (T + 63) // 64  # sharer bitmap words (full_map)
     return SimState(

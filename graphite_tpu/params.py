@@ -42,6 +42,12 @@ def _ceil_log2(x: int) -> int:
     return max(0, (x - 1).bit_length())
 
 
+def _positive(value: int, path: str) -> int:
+    if value < 1:
+        raise ConfigError(f"{path} must be >= 1: {value}")
+    return value
+
+
 def _ceil_pow2(x: int) -> int:
     return 1 << _ceil_log2(x)
 
@@ -339,6 +345,12 @@ class SimParams:
     directory_conflict_rounds: int
     rounds_per_quantum: int
     quanta_per_step: int
+    # Max invalidation fan-outs (EX-on-S invalidation sets + shared-victim
+    # directory evictions) delivered per conflict round; requests beyond the
+    # budget defer to the next round (counted in dir_deferrals).  Bounds the
+    # per-round invalidation scatter at [budget, T] instead of [T, T].
+    max_inv_fanout_per_round: int
+    channel_depth: int
 
     @property
     def line_size(self) -> int:
@@ -411,4 +423,8 @@ class SimParams:
             directory_conflict_rounds=cfg.get_int("tpu/directory_conflict_rounds"),
             rounds_per_quantum=cfg.get_int("tpu/rounds_per_quantum", 4),
             quanta_per_step=cfg.get_int("tpu/quanta_per_step"),
+            max_inv_fanout_per_round=_positive(cfg.get_int(
+                "tpu/max_inv_fanout_per_round", 8),
+                "tpu/max_inv_fanout_per_round"),
+            channel_depth=cfg.get_int("tpu/channel_depth", 16),
         )
