@@ -24,6 +24,7 @@ from typing import NamedTuple, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from graphite_tpu.engine import dense
 from graphite_tpu.params import CacheParams
 
 # Coherence state codes (cache lines AND directory entries).
@@ -64,13 +65,18 @@ class ProbeResult(NamedTuple):
     set_idx: jnp.ndarray   # [T] int32
 
 
+# Dense one-hot set addressing (see engine/dense.py for the TPU-lowering
+# rationale: indexed gather/scatter serializes per row; these don't).
+_set_onehot = dense.onehot
+_row_gather = dense.row_gather
+
+
 def probe(cache: CacheArrays, line: jnp.ndarray, num_sets: int) -> ProbeResult:
     """Look up ``line`` ([T] int64, one per tile) in each tile's cache."""
-    T = cache.tags.shape[0]
     sidx = set_index(line, num_sets)
-    rows = jnp.arange(T)
-    tags_set = cache.tags[rows, sidx]      # [T, A]
-    state_set = cache.state[rows, sidx]    # [T, A]
+    oh = _set_onehot(sidx, num_sets)
+    tags_set = _row_gather(cache.tags, oh)     # [T, A]
+    state_set = _row_gather(cache.state, oh)   # [T, A]
     match = (tags_set == line[:, None]) & (state_set != I)
     hit = match.any(axis=1)
     way = jnp.argmax(match, axis=1).astype(jnp.int32)
@@ -79,29 +85,34 @@ def probe(cache: CacheArrays, line: jnp.ndarray, num_sets: int) -> ProbeResult:
     return ProbeResult(hit=hit, way=way, state=st, set_idx=sidx)
 
 
+def _promote(ranks: jnp.ndarray, way: jnp.ndarray) -> jnp.ndarray:
+    """LRU rank row after promoting ``way`` to MRU (rank 0)."""
+    r_w = jnp.take_along_axis(ranks, way[:, None], axis=1)
+    return jnp.where(
+        jnp.arange(ranks.shape[1])[None, :] == way[:, None],
+        0, ranks + (ranks < r_w))
+
+
 def touch(cache: CacheArrays, set_idx: jnp.ndarray, way: jnp.ndarray,
           active: jnp.ndarray) -> CacheArrays:
     """Promote (set_idx, way) to MRU for tiles where ``active``."""
-    T = cache.tags.shape[0]
-    rows = jnp.arange(T)
-    ranks = cache.lru[rows, set_idx]                       # [T, A]
-    r_w = jnp.take_along_axis(ranks, way[:, None], axis=1)  # [T, 1]
-    promoted = jnp.where(
-        jnp.arange(ranks.shape[1])[None, :] == way[:, None],
-        0, ranks + (ranks < r_w))
-    new = jnp.where(active[:, None], promoted, ranks)
-    return cache._replace(lru=cache.lru.at[rows, set_idx].set(new))
+    num_sets = cache.lru.shape[1]
+    oh = _set_onehot(set_idx, num_sets) & active[:, None]
+    ranks = _row_gather(cache.lru, oh)
+    promoted = _promote(ranks, way)
+    lru = jnp.where(oh[:, :, None], promoted[:, None, :], cache.lru)
+    return cache._replace(lru=lru)
 
 
 def set_state(cache: CacheArrays, set_idx: jnp.ndarray, way: jnp.ndarray,
               new_state: jnp.ndarray, active: jnp.ndarray) -> CacheArrays:
-    """State transition on an existing line (masked scatter)."""
-    T = cache.tags.shape[0]
-    rows = jnp.arange(T)
-    way_eff = jnp.where(active, way, cache.tags.shape[2]).astype(jnp.int32)
-    return cache._replace(
-        state=cache.state.at[rows, set_idx, way_eff].set(
-            new_state, mode="drop"))
+    """State transition on an existing line (dense masked rewrite)."""
+    A = cache.tags.shape[2]
+    oh = _set_onehot(set_idx, cache.tags.shape[1]) & active[:, None]
+    sel = oh[:, :, None] & (jnp.arange(A)[None, None, :] == way[:, None, None])
+    ns = jnp.broadcast_to(
+        jnp.asarray(new_state, jnp.int32).reshape(-1, 1, 1), sel.shape)
+    return cache._replace(state=jnp.where(sel, ns, cache.state))
 
 
 class FillResult(NamedTuple):
@@ -118,21 +129,22 @@ def fill(cache: CacheArrays, line: jnp.ndarray, new_state: jnp.ndarray,
     (reference: cache_set.cc replace() + lru_replacement_policy.cc).
     Returns the victim so the caller can model writeback/coherence."""
     T, _, A = cache.tags.shape
-    rows = jnp.arange(T)
     sidx = set_index(line, num_sets)
-    state_set = cache.state[rows, sidx]
-    tags_set = cache.tags[rows, sidx]
+    oh = _set_onehot(sidx, num_sets)
+    state_set = _row_gather(cache.state, oh)
+    tags_set = _row_gather(cache.tags, oh)
     invalid = state_set == I
     has_invalid = invalid.any(axis=1)
     first_invalid = jnp.argmax(invalid, axis=1)
+    oh_act = oh & active[:, None]
     if replacement == "round_robin":
-        ptr = cache.rr_ptr[rows, sidx]
+        ptr = _row_gather(cache.rr_ptr[:, :, None], oh)[:, 0]
         policy_way = ptr % A
         cache = cache._replace(
-            rr_ptr=cache.rr_ptr.at[rows, sidx].set(
-                jnp.where(active, (ptr + 1) % A, ptr)))
+            rr_ptr=jnp.where(oh_act, ((ptr + 1) % A)[:, None],
+                             cache.rr_ptr))
     else:
-        policy_way = jnp.argmax(cache.lru[rows, sidx], axis=1)
+        policy_way = jnp.argmax(_row_gather(cache.lru, oh), axis=1)
     way = jnp.where(has_invalid, first_invalid, policy_way).astype(jnp.int32)
 
     victim_tag = jnp.take_along_axis(tags_set, way[:, None], axis=1)[:, 0]
@@ -140,10 +152,16 @@ def fill(cache: CacheArrays, line: jnp.ndarray, new_state: jnp.ndarray,
         active,
         jnp.take_along_axis(state_set, way[:, None], axis=1)[:, 0], I)
 
-    way_eff = jnp.where(active, way, A).astype(jnp.int32)
+    sel = oh_act[:, :, None] \
+        & (jnp.arange(A)[None, None, :] == way[:, None, None])
     cache = cache._replace(
-        tags=cache.tags.at[rows, sidx, way_eff].set(line, mode="drop"),
-        state=cache.state.at[rows, sidx, way_eff].set(new_state, mode="drop"),
+        tags=jnp.where(sel, line[:, None, None], cache.tags),
+        state=jnp.where(
+            sel,
+            jnp.broadcast_to(
+                jnp.asarray(new_state, jnp.int32).reshape(-1, 1, 1),
+                sel.shape),
+            cache.state),
     )
     cache = touch(cache, sidx, way, active)
     return FillResult(cache=cache, way=way, victim_tag=victim_tag,

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.engine import dense
 from graphite_tpu.engine import noc
 from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_EX_REQ, PEND_IFETCH, PEND_MUTEX, PEND_NONE,
@@ -63,7 +64,7 @@ def local_advance(params: SimParams, state: SimState,
     quantum boundary, stream end, or its first remote-blocking event."""
 
     T = params.num_tiles
-    N = trace.ops.shape[1]
+    N = trace.num_events
     line_bits = params.line_size.bit_length() - 1
     rows = jnp.arange(T)
     chan_depth = state.ch_time.shape[2]
@@ -76,10 +77,11 @@ def local_advance(params: SimParams, state: SimState,
         active = (~st.done) & (st.pend_kind == PEND_NONE) \
             & (st.clock < st.boundary) & (st.cursor < N)
         cur = jnp.minimum(st.cursor, N - 1)
-        op = jnp.where(active, trace.ops[rows, cur], EventOp.NOP)
+        ev = trace.meta[rows, cur]             # [T, 3] one fused gather
         addr = trace.addr[rows, cur]
-        arg = trace.arg[rows, cur]
-        arg2 = trace.arg2[rows, cur]
+        op = jnp.where(active, ev[:, 0], EventOp.NOP)
+        arg = ev[:, 1]
+        arg2 = ev[:, 2]
 
         # Per-tile clock periods (DVFS-aware), ps per cycle.
         p_core = _period(st, DVFSModule.CORE)
@@ -124,8 +126,8 @@ def local_advance(params: SimParams, state: SimState,
         correct = pred == taken
         dt_br = jnp.where(correct, cycle_ps,
                           _lat(params.core.bp_mispredict_penalty, p_core)) + l1i_ps
-        bidx_eff = jnp.where(is_br, bidx, params.core.bp_size).astype(jnp.int32)
-        bp_table = st.bp_table.at[rows, bidx_eff].set(taken, mode="drop")
+        bp_sel = is_br[:, None] & dense.onehot(bidx, params.core.bp_size)
+        bp_table = jnp.where(bp_sel, taken[:, None], st.bp_table)
 
         # ------------------------------------------------- MEMORY OPERANDS
         is_rd = op == EventOp.MEM_READ
@@ -145,22 +147,28 @@ def local_advance(params: SimParams, state: SimState,
         is_send_op = op == EventOp.SEND
         is_recv = op == EventOp.RECV
         dst = jnp.clip(arg2, 0, T - 1)
-        ch_full = (st.ch_sent[rows, dst] - st.ch_recvd[rows, dst]) >= chan_depth
+        dst_oh = dense.onehot(dst, T)
+        sent_row = jnp.sum(jnp.where(dst_oh, st.ch_sent, 0), axis=1)
+        recvd_row = jnp.sum(jnp.where(dst_oh, st.ch_recvd, 0), axis=1)
+        ch_full = (sent_row - recvd_row) >= chan_depth
         is_send = is_send_op & ~ch_full
         send_block = is_send_op & ch_full
         send_net_ps = noc.unicast_ps(
             params.net_user, rows, dst, jnp.maximum(arg, 0), p_nu,
             params.mesh_width)
-        slot_idx = st.ch_sent[rows, dst] % chan_depth
+        slot_idx = sent_row % chan_depth
         # The reused ring slot holds the consuming recv's completion time
         # (written by resolve_recv): even when the count check shows space,
         # the message can't occupy the slot before the recv that freed it.
-        slot_freed = st.ch_time[rows, dst, slot_idx]
+        slot_oh = dst_oh[:, :, None] & dense.onehot(
+            slot_idx, chan_depth)[:, None, :]
+        slot_freed = jnp.sum(
+            jnp.where(slot_oh, st.ch_time, 0), axis=(1, 2))
         arrival = jnp.maximum(st.clock + cycle_ps, slot_freed) + send_net_ps
-        src_eff = jnp.where(is_send, rows, T).astype(jnp.int32)
-        ch_time = st.ch_time.at[src_eff, dst, slot_idx].set(
-            arrival, mode="drop")
-        ch_sent = st.ch_sent.at[src_eff, dst].add(1, mode="drop")
+        send_sel = slot_oh & is_send[:, None, None]
+        ch_time = jnp.where(send_sel, arrival[:, None, None], st.ch_time)
+        ch_sent = st.ch_sent + jnp.where(
+            dst_oh & is_send[:, None], 1, 0).astype(st.ch_sent.dtype)
         dt_send = cycle_ps
 
         # ------------------------------------------------------ SYNC OPS
@@ -170,19 +178,21 @@ def local_advance(params: SimParams, state: SimState,
         to_mcp_ps = noc.unicast_ps(
             params.net_user, rows, jnp.full((T,), mcp), 8, p_nu,
             params.mesh_width)
+        NEG = jnp.int64(-(2**62))
         # barrier arrival bookkeeping (server side of SimBarrier)
         bar_id = jnp.clip(arg, 0, num_bars - 1)
-        bar_eff = jnp.where(is_bar, bar_id, num_bars).astype(jnp.int32)
-        bar_count = st.bar_count.at[bar_eff].add(1, mode="drop")
-        bar_time = st.bar_time.at[bar_eff].max(
-            st.clock + to_mcp_ps, mode="drop")
+        bar_oh = dense.onehot(bar_id, num_bars)
+        bar_count = st.bar_count + dense.binsum(
+            bar_oh, is_bar, 1).astype(st.bar_count.dtype)
+        bar_time = jnp.maximum(st.bar_time, dense.binmax(
+            bar_oh, is_bar, st.clock + to_mcp_ps, NEG))
         # unlock: release the mutex at MCP-arrival time; requester pays the
         # round trip (SyncClient blocks on the ack, sync_client.h:10-30)
         lock_id = jnp.clip(arg, 0, num_locks - 1)
-        ul_eff = jnp.where(is_unlock, lock_id, num_locks).astype(jnp.int32)
-        lock_holder = st.lock_holder.at[ul_eff].set(0, mode="drop")
-        lock_free_at = st.lock_free_at.at[ul_eff].max(
-            st.clock + to_mcp_ps + cycle_ps, mode="drop")
+        ul_oh = dense.onehot(lock_id, num_locks) & is_unlock[:, None]
+        lock_holder = jnp.where(ul_oh.any(axis=0), 0, st.lock_holder)
+        lock_free_at = jnp.maximum(st.lock_free_at, dense.binmax(
+            ul_oh, is_unlock, st.clock + to_mcp_ps + cycle_ps, NEG))
         dt_unlock = 2 * to_mcp_ps + 2 * cycle_ps
 
         # ------------------------------------------------ SIMPLE/DYNAMIC OPS
@@ -193,14 +203,14 @@ def local_advance(params: SimParams, state: SimState,
         is_done = op == EventOp.DONE
         dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
         dt_dvfs = _lat(params.dvfs_sync_delay_cycles, p_core)
-        mod_eff = jnp.where(is_dvfs,
-                            jnp.clip(arg, 0, state.period_ps.shape[1] - 1),
-                            state.period_ps.shape[1]).astype(jnp.int32)
+        nmod = state.period_ps.shape[1]
+        mod_oh = is_dvfs[:, None] & dense.onehot(
+            jnp.clip(arg, 0, nmod - 1), nmod)
         # arg2 carries the new frequency in MHz (schema dvfs_set);
         # period_ps = round(1e6 / MHz).
         mhz = jnp.maximum(arg2, 1)
-        period_ps = st.period_ps.at[rows, mod_eff].set(
-            ((1_000_000 + mhz // 2) // mhz).astype(jnp.int32), mode="drop")
+        new_period = ((1_000_000 + mhz // 2) // mhz).astype(jnp.int32)
+        period_ps = jnp.where(mod_oh, new_period[:, None], st.period_ps)
 
         # ------------------------------------------------------ combine dt
         dt = jnp.zeros(T, dtype=jnp.int64)

@@ -1,0 +1,63 @@
+"""Dense one-hot primitives for TPU-friendly indexed access.
+
+TPU lowering rationale (measured on v5e): XLA lowers real gather/scatter
+ops over small arrays to a serialized per-index-row loop (~10 us per op
+regardless of payload), while dense masked selects/reduces lower to fused
+vector ops at HBM bandwidth (<1 us for this engine's array sizes).  Every
+hot-path operation indexed by a [T]-shaped vector therefore goes through a
+one-hot mask plus a masked reduce (gather) or masked select (scatter).
+
+Dense one-hots are O(rows * bins) memory; callers that bin into large
+spaces (the election hash tables) fall back to real scatters above
+``DENSE_MAX_ELEMS`` — at those sizes the serialized scatter is amortized.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+DENSE_MAX_ELEMS = 1 << 22
+
+
+def fmix64(x: jnp.ndarray) -> jnp.ndarray:
+    """64-bit avalanche mix (MurmurHash3 fmix64, one multiply round) —
+    decorrelates power-of-two-strided keys before a power-of-two modulo."""
+    x = x.astype(jnp.uint64)
+    x ^= x >> 33
+    x *= jnp.uint64(0xFF51AFD7ED558CCD)
+    x ^= x >> 33
+    return x
+
+
+def onehot(idx: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[R, n] bool: oh[r, j] = (idx[r] == j)."""
+    return idx[:, None] == jnp.arange(n, dtype=idx.dtype)[None, :]
+
+
+def sel(oh: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """Dense gather vals[idx]: [R, n] one-hot x [n] -> [R]."""
+    return jnp.sum(jnp.where(oh, vals[None, :], 0), axis=1, dtype=vals.dtype)
+
+
+def row_gather(arr: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
+    """[R, n, ...] x [R, n] -> [R, ...]: masked sum over the bin axis
+    (exactly one bin selected per row, so the sum IS the row)."""
+    mask = oh.reshape(oh.shape + (1,) * (arr.ndim - 2))
+    return jnp.sum(jnp.where(mask, arr, 0), axis=1, dtype=arr.dtype)
+
+
+def binsum(oh: jnp.ndarray, mask: jnp.ndarray, val) -> jnp.ndarray:
+    """Dense scatter-add: per-bin sum of val[r] over rows with mask.
+
+    ``oh`` [R, n], ``mask`` [R], ``val`` scalar or [R] -> [n] int64.
+    """
+    v = jnp.asarray(val, jnp.int64)
+    v = jnp.broadcast_to(v.reshape(-1, 1), oh.shape) if v.ndim else \
+        jnp.full(oh.shape, v)
+    return jnp.sum(jnp.where(oh & mask[:, None], v, 0), axis=0)
+
+
+def binmax(oh: jnp.ndarray, mask: jnp.ndarray, val: jnp.ndarray,
+           init) -> jnp.ndarray:
+    """Dense scatter-max: per-bin max of val[r] over rows with mask."""
+    return jnp.max(jnp.where(oh & mask[:, None], val[:, None], init), axis=0)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.engine import dense
 from graphite_tpu.engine import directory as dirmod
 from graphite_tpu.engine import noc
 from graphite_tpu.engine import queue_models
@@ -51,7 +52,29 @@ def home_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
     return ((line % n) * params.dram.controller_home_stride).astype(jnp.int32)
 
 
+def dir_set_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
+    """Directory set within a home slice, XOR-folding the high line bits.
+
+    A plain ``(line // nctl) % ndsets`` aliases power-of-two-strided
+    allocations (e.g. per-tile buffers spaced nctl*ndsets lines apart) into
+    the same set and thrashes an otherwise nearly-empty directory; folding
+    the bits above the set index breaks such strides.  (The reference's
+    directory cache hashes the address into its sets the same
+    way generic caches do — directory_cache.cc getSetIndex.)
+    """
+    ndsets = params.directory.num_sets
+    x = line // params.dram.num_controllers
+    bits = ndsets.bit_length() - 1
+    x = x ^ (x >> bits) ^ (x >> (2 * bits)) ^ (x >> (3 * bits))
+    return (x % ndsets).astype(jnp.int32)
+
+
 _BIG = jnp.int64(2**62)
+
+_oh = dense.onehot
+_sel = dense.sel
+_binsum = dense.binsum
+_DENSE_MAX_ELEMS = dense.DENSE_MAX_ELEMS
 
 
 def _fcfs_keys(active, issue) -> jnp.ndarray:
@@ -69,9 +92,19 @@ def _fcfs_keys(active, issue) -> jnp.ndarray:
 
 
 def _elect(active, packed, idx, size):
-    """Scatter-min FCFS election: the earliest active row per ``idx`` value
-    wins (one winner per table slot; a hash collision between two distinct
-    keys mapping to one slot only defers the later row)."""
+    """Min-FCFS election: the earliest active row per ``idx`` value wins
+    (one winner per table slot; a hash collision between two distinct keys
+    mapping to one slot only defers the later row).
+
+    Dense [R, size] mask form when it fits; scatter-min table above the
+    size cap (large T), where the serialized scatter is amortized anyway.
+    """
+    R = packed.shape[0]
+    if R * size <= _DENSE_MAX_ELEMS:
+        oh = _oh(idx, size)
+        tbl = jnp.min(jnp.where(oh & active[:, None], packed[:, None], _BIG),
+                      axis=0)
+        return active & (_sel(oh, tbl) == packed)
     tbl = jnp.full((size,), _BIG, dtype=jnp.int64).at[
         jnp.where(active, idx, size)].min(packed, mode="drop")
     return active & (tbl[idx] == packed)
@@ -128,10 +161,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     is_ex = state.pend_kind == PEND_EX_REQ
     is_if = state.pend_kind == PEND_IFETCH
     home = home_of_line(params, line)
-    dset = ((line // nctl) % ndsets).astype(jnp.int32)
+    dset = dir_set_of_line(params, line)
     issue = state.pend_issue
     packed = _fcfs_keys(is_req, issue)
-    hidx = (line % H).astype(jnp.int32)
+    # Election-table slot: a full 64-bit mix before the modulo — plain
+    # ``line % H`` collapses power-of-two-strided per-tile buffers (which
+    # park in near-lockstep) onto a handful of slots, serializing requests
+    # that share nothing.
+    hidx = (dense.fmix64(line) % jnp.uint64(H)).astype(jnp.int32)
 
     # Per-tile clock periods.
     p_net = _period(state, DVFSModule.NETWORK_MEMORY)
@@ -148,74 +185,115 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
                                params.net_memory.flit_width_bits)
 
+    # Conflict-round invariants, hoisted out of the loop: each pending
+    # request's home/line/set and everything derived only from them.
+    oh_home = _oh(home, T)                     # [T, T]
+    p_net_home = _sel(oh_home, p_net).astype(jnp.int32)
+    p_dir_home = _sel(oh_home, p_dir).astype(jnp.int32)
+    dense_tables = T * H <= _DENSE_MAX_ELEMS
+    oh_hidx = _oh(hidx, H) if dense_tables else None
+    net_req = noc.unicast_ps(params.net_memory, rows, home, CTRL_BYTES,
+                             p_net, params.mesh_width)
+    reply_ps = noc.unicast_ps(params.net_memory, home, rows,
+                              params.line_size + CTRL_BYTES, p_net_home,
+                              params.mesh_width)
+    dir_ps = _lat(params.directory.access_cycles, p_dir_home)
+    fidx = (home * ndsets + dset).astype(jnp.int32)
+
     def round_body(carry):
         _i, state, resolved, line_floor = carry
         unres = is_req & ~resolved
 
         # ---- earliest-per-line election (the directory FSM serialization)
-        win = _elect(unres, packed, hidx, H)
+        if dense_tables:
+            tbl = jnp.min(jnp.where(oh_hidx & unres[:, None],
+                                    packed[:, None], _BIG), axis=0)
+            win = unres & (_sel(oh_hidx, tbl) == packed)
+        else:
+            win = _elect(unres, packed, hidx, H)
 
-        # ---- directory-cache probe at (home, dset)
-        dtags = state.dir_tags[home, dset]      # [T, A]
-        dstate = state.dir_state[home, dset]
+        # ---- directory-cache probe at (home, dset), via the flat
+        # (home*ndsets + dset) index — one gather per field
+        dtags = state.dir_tags.reshape(-1, A)[fidx]          # [T, A]
+        dstate = state.dir_state.reshape(-1, A)[fidx]
         match = (dtags == line[:, None]) & (dstate != I)
         hit = match.any(axis=1)
         hway = jnp.argmax(match, axis=1).astype(jnp.int32)
-        dlru = state.dir_lru[home, dset]
+        dlru = state.dir_lru.reshape(-1, A)[fidx]
         invalid = dstate == I
-        # Allocating requests spread over the set's invalid ways by
-        # requester id (different tiles cold-missing into the same home set
-        # — the common case under tile-symmetric address layouts — install
-        # in parallel instead of re-computing one identical alloc_way).
-        n_inv = jnp.sum(invalid, axis=1).astype(jnp.int32)
-        kth = (rows % jnp.maximum(n_inv, 1)).astype(jnp.int32)
-        inv_rank = jnp.cumsum(invalid.astype(jnp.int32), axis=1)
-        kth_invalid = jnp.argmax(
-            invalid & (inv_rank == (kth + 1)[:, None]), axis=1)
-        alloc_way = jnp.where(n_inv > 0, kth_invalid,
-                              jnp.argmax(dlru, axis=1)).astype(jnp.int32)
-        way = jnp.where(hit, hway, alloc_way)
 
-        # ---- way-slot election: at most one winner per (home, dset, way)
-        # per round.  A miss installing into a way that another winner (a
-        # hit re-reading it, or another miss allocating it) touches in the
-        # same round would silently lose a directory entry; all winners
-        # compete for their way slot and losers defer a round.  (Two *hit*
-        # winners can never collide: a way holds one tag and the per-line
-        # election already picked one winner for it.)
-        aidx = (((home.astype(jnp.int64) * ndsets + dset) * A + way)
-                % H).astype(jnp.int32)
-        alloc_defer = win & ~_elect(win, packed, aidx, H)
+        # ---- victim-way assignment for allocating (miss) winners.  The
+        # home directory serves same-set requests in FCFS order, each
+        # evicting the then-LRU way — so the k-th miss winner of a
+        # (home, dset) group this round takes the way with the k-th highest
+        # replacement priority (invalid ways first, then LRU rank), and
+        # ways touched by a hit winner are excluded.  Distinct ways per
+        # group mean the winners' directory installs never collide.
+        # [T, T] dense compares — cheap on TPU; only materialized pairs
+        # would be O(T^2)-expensive.
+        hitwin = win & hit
+        misswin = win & ~hit
+        same_hs = fidx[:, None] == fidx[None, :]
+        grank = jnp.sum(
+            same_hs & (packed[None, :] < packed[:, None])
+            & misswin[:, None] & misswin[None, :], axis=1).astype(jnp.int32)
+        hway_used = jnp.any(
+            same_hs[:, :, None] & hitwin[None, :, None]
+            & (hway[None, :, None]
+               == jnp.arange(A, dtype=jnp.int32)[None, None, :]), axis=1)
+        # Replacement priority: hit-held ways never; invalid ways first
+        # (rank + A sorts them above every valid way), then LRU.
+        prio = jnp.where(hway_used, -1, dlru + jnp.where(invalid, A, 0))
+        pos = jnp.sum(
+            (prio[:, None, :] > prio[:, :, None])
+            | ((prio[:, None, :] == prio[:, :, None])
+               & (jnp.arange(A)[None, None, :] < jnp.arange(A)[None, :, None])),
+            axis=2).astype(jnp.int32)          # [T, A] descending-order pos
+        n_elig = jnp.sum(prio >= 0, axis=1).astype(jnp.int32)
+        miss_way = jnp.argmax(pos == grank[:, None], axis=1).astype(jnp.int32)
+        can_alloc = misswin & (grank < n_elig)
+        way = jnp.where(hit, hway, miss_way)
+
+        # ---- way-slot election safety net: hash collisions in the line
+        # election can still hand two winners the same (home, dset, way);
+        # the later one defers a round rather than corrupt the entry.
+        # The flat slot id is fmix64-mixed before the modulo: unmixed,
+        # ndsets*A is a multiple of H and the home tile cancels out of the
+        # hash, colliding every same-(dset, way) request across homes.
+        am = (home.astype(jnp.int64) * ndsets + dset) * A + way
+        aidx = (dense.fmix64(am) % jnp.uint64(H)).astype(jnp.int32)
+        alloc_defer = win & ((misswin & ~can_alloc)
+                             | ~_elect(win, packed, aidx, H))
         win = win & ~alloc_defer
+        misswin = misswin & ~alloc_defer
 
-        evicting = win & ~hit & ~invalid.any(axis=1)
+        evicting = misswin & jnp.take_along_axis(
+            dstate != I, way[:, None], axis=1)[:, 0]
 
+        downer = state.dir_owner.reshape(-1, A)[fidx]        # [T, A]
+        dsharers = state.dir_sharers.reshape(-1, A, W)[fidx]  # [T, A, W]
         entry_state = jnp.where(
             hit, jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
         entry_owner = jnp.where(
             hit,
-            jnp.take_along_axis(state.dir_owner[home, dset], way[:, None],
-                                axis=1)[:, 0], -1)
+            jnp.take_along_axis(downer, way[:, None], axis=1)[:, 0], -1)
         entry_sharers = jnp.where(
             hit[:, None],
             jnp.take_along_axis(
-                state.dir_sharers[home, dset], way[:, None, None],
-                axis=1)[:, 0, :],
+                dsharers, way[:, None, None], axis=1)[:, 0, :],
             jnp.zeros((T, W), dtype=jnp.uint64))
 
         # Victim directory entry being replaced (reference invalidates all
         # of the victim's sharers/owner on directory-cache replacement —
         # dram_directory_cntlr replacement path; leaving them cached would
         # let a later request grant M while stale copies still hit).
-        vtag = jnp.take_along_axis(dtags, alloc_way[:, None], axis=1)[:, 0]
+        vtag = jnp.take_along_axis(dtags, way[:, None], axis=1)[:, 0]
         vstate = jnp.where(
             evicting,
-            jnp.take_along_axis(dstate, alloc_way[:, None], axis=1)[:, 0], I)
-        vowner = jnp.take_along_axis(
-            state.dir_owner[home, dset], alloc_way[:, None], axis=1)[:, 0]
+            jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
+        vowner = jnp.take_along_axis(downer, way[:, None], axis=1)[:, 0]
         vsharers = jnp.take_along_axis(
-            state.dir_sharers[home, dset], alloc_way[:, None, None],
-            axis=1)[:, 0, :]
+            dsharers, way[:, None, None], axis=1)[:, 0, :]
         evict_m = evicting & (vstate == M) & (vowner >= 0)
         # Empty-S entries (every sharer already dropped the line silently)
         # need no invalidation traffic — don't burn a fan-out slot on them.
@@ -241,58 +319,72 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         evict_s = evict_s & ~fan_defer
         evicting = evicting & ~fan_defer
 
-        # Selected fan-out rows gathered into [K] slots.
-        sel_slot = jnp.where(sel, rank, K)
-        sel_rows = jnp.full((K,), T, dtype=jnp.int32).at[sel_slot].set(
-            rows.astype(jnp.int32), mode="drop")
-        sel_ok = sel_rows < T
-        sr = jnp.minimum(sel_rows, T - 1)
+        # Selected fan-out rows, as a dense [K, T] slot-assignment mask
+        # (oh_sr[k, t] <=> requester t owns fan-out slot k this round).
+        oh_sr = sel[None, :] & (
+            jnp.arange(K, dtype=jnp.int32)[:, None] == rank[None, :])
 
-        inv_words = act.inv_targets[sr] & jnp.where(
-            (sel_ok & has_inv[sr])[:, None], ~jnp.uint64(0), jnp.uint64(0))
-        vic_words = vsharers[sr] & jnp.where(
-            (sel_ok & evict_s[sr])[:, None], ~jnp.uint64(0), jnp.uint64(0))
+        def sr_sel(vals):     # [T] -> [K] values of each slot's requester
+            return jnp.sum(jnp.where(oh_sr, vals[None, :], 0), axis=1,
+                           dtype=vals.dtype)
+
+        inv_words = jnp.sum(
+            jnp.where((oh_sr & has_inv[None, :])[:, :, None],
+                      act.inv_targets[None, :, :], jnp.uint64(0)),
+            axis=1, dtype=jnp.uint64)                    # [K, W]
+        vic_words = jnp.sum(
+            jnp.where((oh_sr & evict_s[None, :])[:, :, None],
+                      vsharers[None, :, :], jnp.uint64(0)),
+            axis=1, dtype=jnp.uint64)
         inv_bool = dirmod.bitmap_to_bool(inv_words, T)   # [K, T]
         vic_bool = dirmod.bitmap_to_bool(vic_words, T)   # [K, T]
 
-        # Invalidation round-trip latencies, scattered back per requester.
+        home_sr = sr_sel(home)
+        pnh_sr = sr_sel(p_net_home.astype(jnp.int64)).astype(jnp.int32)
+        cyc_sr = sr_sel(cycle_ps)
+
+        # Invalidation round-trip latencies, mapped back per requester.
         inv_ps_k = 2 * noc.max_hop_to_mask_ps(
-            params.net_memory, home[sr], inv_bool, CTRL_BYTES,
-            p_net[home[sr]], params.mesh_width) + cycle_ps[sr]
+            params.net_memory, home_sr, inv_bool, CTRL_BYTES,
+            pnh_sr, params.mesh_width) + cyc_sr
         vic_ps_k = 2 * noc.max_hop_to_mask_ps(
-            params.net_memory, home[sr], vic_bool, CTRL_BYTES,
-            p_net[home[sr]], params.mesh_width) + cycle_ps[sr]
-        inv_ps = jnp.zeros(T, dtype=jnp.int64).at[sel_rows].set(
-            jnp.where(sel_ok & has_inv[sr], inv_ps_k, 0), mode="drop")
-        evict_ps = jnp.zeros(T, dtype=jnp.int64).at[sel_rows].set(
-            jnp.where(sel_ok & evict_s[sr], vic_ps_k, 0), mode="drop")
+            params.net_memory, home_sr, vic_bool, CTRL_BYTES,
+            pnh_sr, params.mesh_width) + cyc_sr
+        inv_ps = jnp.where(has_inv, jnp.sum(
+            jnp.where(oh_sr, inv_ps_k[:, None], 0), axis=0), 0)
+        evict_ps = jnp.where(evict_s, jnp.sum(
+            jnp.where(oh_sr, vic_ps_k[:, None], 0), axis=0), 0)
         # M-state victim: single-owner flush round trip.
+        vown_c = jnp.maximum(vowner, 0)
+        oh_vown = _oh(vown_c, T)
+        p_net_vown = _sel(oh_vown, p_net).astype(jnp.int32)
+        p_l2_vown = _sel(oh_vown, p_l2).astype(jnp.int32)
         evict_m_ps = noc.unicast_ps(
-            params.net_memory, home, jnp.maximum(vowner, 0), CTRL_BYTES,
+            params.net_memory, home, vown_c, CTRL_BYTES,
             p_net, params.mesh_width) \
-            + _lat(params.l2.access_cycles, p_l2[jnp.maximum(vowner, 0)]) \
+            + _lat(params.l2.access_cycles, p_l2_vown) \
             + noc.unicast_ps(
-                params.net_memory, jnp.maximum(vowner, 0), home,
+                params.net_memory, vown_c, home,
                 params.line_size + CTRL_BYTES,
-                p_net[jnp.maximum(vowner, 0)], params.mesh_width)
+                p_net_vown, params.mesh_width)
         evict_ps = jnp.where(evict_m, evict_m_ps, evict_ps)
 
         # ---- latency assembly (SURVEY.md 3.3's round trips, analytically)
-        net_req = noc.unicast_ps(params.net_memory, rows, home, CTRL_BYTES,
-                                 p_net, params.mesh_width)
         arrive = jnp.maximum(issue + net_req, line_floor)
-        dir_ps = _lat(params.directory.access_cycles, p_dir[home])
         # Replacement of a live victim entry completes before the new
         # request is served.
         t_dir = arrive + dir_ps + jnp.where(evicting, evict_ps, 0)
 
         owner = act.owner_tile
         owner_leg = act.owner_leg & win
+        oh_owner = _oh(owner, T)
+        p_net_own = _sel(oh_owner, p_net).astype(jnp.int32)
+        p_l2_own = _sel(oh_owner, p_l2).astype(jnp.int32)
         leg_ps = noc.unicast_ps(params.net_memory, home, owner, CTRL_BYTES,
-                                p_net[home], params.mesh_width) \
-            + _lat(params.l2.access_cycles, p_l2[owner]) \
+                                p_net_home, params.mesh_width) \
+            + _lat(params.l2.access_cycles, p_l2_own) \
             + noc.unicast_ps(params.net_memory, owner, home,
-                             params.line_size + CTRL_BYTES, p_net[owner],
+                             params.line_size + CTRL_BYTES, p_net_own,
                              params.mesh_width)
         owner_ps = jnp.where(owner_leg, leg_ps, 0)
 
@@ -302,20 +394,15 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                               jnp.full(T, dram_service_ps), need_read,
                               state.dram_free_at)
         dram_ready = q.start + dram_access_ps + dram_service_ps
-        state = state._replace(dram_free_at=q.free_at)
         # Writebacks (owner-leg flushes, dirty victim evictions) occupy the
         # controller off the critical path (write buffer): occupancy only.
-        state = state._replace(dram_free_at=state.dram_free_at.at[
-            jnp.where(owner_leg | evict_m, home, T)].add(
-                dram_service_ps, mode="drop"))
+        state = state._replace(dram_free_at=q.free_at + _binsum(
+            oh_home, owner_leg | evict_m, dram_service_ps))
 
         t_data = t_dir + owner_ps
         t_data = jnp.maximum(t_data, jnp.where(need_read, dram_ready, 0))
         t_data = jnp.maximum(t_data, t_dir + inv_ps)
 
-        reply_ps = noc.unicast_ps(params.net_memory, home, rows,
-                                  params.line_size + CTRL_BYTES, p_net[home],
-                                  params.mesh_width)
         l2_fill_ps = _lat(params.l2.access_cycles, p_l2)
         l1_fill_ps = jnp.where(
             is_if, _lat(params.l1i.access_cycles,
@@ -336,46 +423,59 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             dir_sharers=state.dir_sharers.at[home_w, dset, way].set(
                 act.new_sharers, mode="drop"),
         )
-        # Dir LRU: promote the touched way (whole-row scatter; colliding
-        # same-set hit winners resolve arbitrarily — bounded inaccuracy).
-        r_w = jnp.take_along_axis(dlru, way[:, None], axis=1)
-        promoted = jnp.where(jnp.arange(A)[None, :] == way[:, None], 0,
-                             dlru + (dlru < r_w))
+        # Dir LRU: merged post-round ranks.  Several same-set winners per
+        # round are the common case (distinct ways by design), so the row
+        # written must reflect ALL of the set's touches: touched ways rank
+        # by touch recency (latest FCFS key = MRU = 0), untouched ways
+        # follow in their pre-round relative order.  Every winner of a set
+        # computes the identical row, so the colliding whole-row scatters
+        # agree.
+        wway_oh = win[None, :, None] & (
+            way[None, :, None] == jnp.arange(A, dtype=jnp.int32)[None, None, :])
+        touched = jnp.any(same_hs[:, :, None] & wway_oh, axis=1)   # [T, A]
+        tkey = jnp.sum(
+            jnp.where(same_hs[:, :, None] & wway_oh,
+                      packed[None, :, None], 0), axis=1)           # [T, A]
+        n_touch = jnp.sum(touched, axis=1, dtype=jnp.int32)
+        rank_t = jnp.sum(
+            touched[:, None, :] & (tkey[:, None, :] > tkey[:, :, None]),
+            axis=2, dtype=jnp.int32)
+        rank_u = n_touch[:, None] + jnp.sum(
+            ~touched[:, None, :] & (dlru[:, None, :] < dlru[:, :, None]),
+            axis=2, dtype=jnp.int32)
+        new_lru_row = jnp.where(touched, rank_t, rank_u)
         state = state._replace(
             dir_lru=state.dir_lru.at[home_w, dset].set(
-                jnp.where(win[:, None], promoted, dlru), mode="drop"))
+                new_lru_row, mode="drop"))
 
-        # ---- owner downgrade (current-entry M) + victim-owner flush
+        # ---- coherence-driven cache-state changes, one batched call per
+        # cache level: owner downgrades (current-entry M), victim-owner
+        # flushes, budgeted sharer invalidations, and victim-entry sharer
+        # invalidations.
+        line_sr = sr_sel(line)
+        vtag_sr = sr_sel(vtag)
+        ktgt = jnp.broadcast_to(rows[None, :], (K, T)).reshape(-1)
         pairs = jnp.concatenate([
             jnp.stack([owner.astype(jnp.int64), line], axis=1),
             jnp.stack([jnp.maximum(vowner, 0).astype(jnp.int64), vtag],
-                      axis=1)], axis=0)
-        pvalid = jnp.concatenate([owner_leg, evict_m], axis=0)
-        pdown = jnp.concatenate(
-            [act.owner_downgrade_to, jnp.full(T, I, dtype=jnp.int32)],
+                      axis=1),
+            jnp.stack([ktgt.astype(jnp.int64),
+                       jnp.broadcast_to(line_sr[:, None],
+                                        (K, T)).reshape(-1)], axis=1),
+            jnp.stack([ktgt.astype(jnp.int64),
+                       jnp.broadcast_to(vtag_sr[:, None],
+                                        (K, T)).reshape(-1)], axis=1),
+        ], axis=0)
+        pvalid = jnp.concatenate(
+            [owner_leg, evict_m, inv_bool.reshape(-1), vic_bool.reshape(-1)],
             axis=0)
+        pdown = jnp.concatenate(
+            [act.owner_downgrade_to,
+             jnp.full(T + 2 * K * T, I, dtype=jnp.int32)], axis=0)
         l2c, _ = cachemod.invalidate_lines(
             state.l2, pairs, pvalid, params.l2.num_sets, pdown)
         l1c, _ = cachemod.invalidate_lines(
             state.l1d, pairs, pvalid, params.l1d.num_sets, pdown)
-        state = state._replace(l2=l2c, l1d=l1c)
-
-        # ---- budgeted sharer invalidations: line-inv + victim-evict pairs
-        ktgt = jnp.broadcast_to(rows[None, :], (K, T))
-        ipairs = jnp.concatenate([
-            jnp.stack([ktgt.reshape(-1).astype(jnp.int64),
-                       jnp.broadcast_to(line[sr][:, None],
-                                        (K, T)).reshape(-1)], axis=1),
-            jnp.stack([ktgt.reshape(-1).astype(jnp.int64),
-                       jnp.broadcast_to(vtag[sr][:, None],
-                                        (K, T)).reshape(-1)], axis=1),
-        ], axis=0)
-        ivalid = jnp.concatenate(
-            [inv_bool.reshape(-1), vic_bool.reshape(-1)], axis=0)
-        l2c, _ = cachemod.invalidate_lines(
-            state.l2, ipairs, ivalid, params.l2.num_sets, I)
-        l1c, _ = cachemod.invalidate_lines(
-            state.l1d, ipairs, ivalid, params.l1d.num_sets, I)
         state = state._replace(l2=l2c, l1d=l1c)
 
         # ---- requester-side fills (L2 always; L1D or L1I by request kind)
@@ -386,9 +486,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         victim_dirty = win & (f2.victim_state == M)
         victim_live = win & (f2.victim_state != I)
         victim_home = home_of_line(params, f2.victim_tag)
-        state = state._replace(dram_free_at=state.dram_free_at.at[
-            jnp.where(victim_dirty, victim_home, T)].add(
-                dram_service_ps, mode="drop"))
+        oh_vhome = _oh(victim_home, T)
+        state = state._replace(dram_free_at=state.dram_free_at + _binsum(
+            oh_vhome, victim_dirty, dram_service_ps))
         # An evicted-from-L2 line also leaves L1 (inclusive hierarchy,
         # reference l2_cache_cntlr invalidation of L1 on eviction).
         vpairs = jnp.stack([rows.astype(jnp.int64), f2.victim_tag], axis=1)
@@ -413,49 +513,40 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                            params.l1i.replacement)
         state = state._replace(l1i=fi.cache)
 
-        # ---- counters
-        def sadd(arr, idx, mask, val=1):
-            return arr.at[jnp.where(mask, idx, T)].add(val, mode="drop")
-
-        inv_count = jnp.zeros(T, dtype=jnp.int64).at[sel_rows].add(
-            jnp.where(sel_ok,
-                      jnp.sum(inv_bool, axis=1) + jnp.sum(vic_bool, axis=1),
-                      0).astype(jnp.int64), mode="drop")
+        # ---- counters (all home-binned tallies via dense one-hot sums)
+        kcnt = (jnp.sum(inv_bool, axis=1)
+                + jnp.sum(vic_bool, axis=1)).astype(jnp.int64)  # [K]
+        inv_count = jnp.sum(jnp.where(oh_sr, kcnt[:, None], 0), axis=0)
         c = state.counters
         c = c._replace(
-            dir_sh_req=sadd(c.dir_sh_req, home, win & ~is_ex),
-            dir_ex_req=sadd(c.dir_ex_req, home, win & is_ex),
-            dir_invalidations=sadd(c.dir_invalidations, home,
-                                   inv_count > 0, inv_count),
-            dir_writebacks=sadd(c.dir_writebacks, home,
-                                owner_leg | evict_m),
-            dir_evictions=sadd(c.dir_evictions, home, evicting),
-            dram_reads=sadd(c.dram_reads, home, need_read),
-            dram_writes=sadd(
-                sadd(c.dram_writes, home, owner_leg | evict_m),
-                victim_home, victim_dirty),
+            dir_sh_req=c.dir_sh_req + _binsum(oh_home, win & ~is_ex, 1),
+            dir_ex_req=c.dir_ex_req + _binsum(oh_home, win & is_ex, 1),
+            dir_invalidations=c.dir_invalidations
+            + _binsum(oh_home, inv_count > 0, inv_count),
+            dir_writebacks=c.dir_writebacks
+            + _binsum(oh_home, owner_leg | evict_m, 1),
+            dir_evictions=c.dir_evictions + _binsum(oh_home, evicting, 1),
+            dram_reads=c.dram_reads + _binsum(oh_home, need_read, 1),
+            dram_writes=c.dram_writes
+            + _binsum(oh_home, owner_leg | evict_m, 1)
+            + _binsum(oh_vhome, victim_dirty, 1),
             net_mem_pkts=c.net_mem_pkts
             + jnp.where(win, 1, 0)                    # request
-            + jnp.where(victim_dirty, 1, 0),          # victim WB data
+            + jnp.where(victim_dirty, 1, 0)           # victim WB data
+            # reply + INV_REQ traffic accounted at the home tile
+            + _binsum(oh_home, win, 1)
+            + _binsum(oh_home, inv_count > 0, inv_count),
             net_mem_flits=c.net_mem_flits
             + jnp.where(win, flits_req, 0)
-            + jnp.where(victim_dirty, flits_data, 0),
+            + jnp.where(victim_dirty, flits_data, 0)
+            + _binsum(oh_home, win, flits_data)
+            + _binsum(oh_home, inv_count > 0, inv_count * flits_req),
+            # Deferral events this round: way-slot collisions + fan-out
+            # budget overflow (a request deferred in N rounds counts N
+            # times; end-of-pass saturation is counted separately below).
+            dir_deferrals=c.dir_deferrals
+            + _binsum(oh_home, alloc_defer | fan_defer, 1),
         )
-        # reply + inv/flush traffic accounted at the home tile
-        c = c._replace(
-            net_mem_pkts=sadd(
-                sadd(c.net_mem_pkts, home, win),       # reply
-                home, inv_count > 0, inv_count),        # INV_REQs
-            net_mem_flits=sadd(
-                sadd(c.net_mem_flits, home, win, flits_data),
-                home, inv_count > 0, inv_count * flits_req),
-        )
-        # Deferral events this round: way-slot collisions + fan-out budget
-        # overflow (a request deferred in N rounds counts N times; end-of-
-        # pass saturation is counted separately below).
-        c = c._replace(
-            dir_deferrals=sadd(c.dir_deferrals, home,
-                               alloc_defer | fan_defer))
         state = state._replace(counters=c)
 
         state = _unblock(state, win, completion, sync=False)
@@ -464,13 +555,23 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # per-line winner's data-availability time, via the same hash table
         # (a stored-line check makes collisions inert).
         t_free = t_data
-        ftbl_line = jnp.full((H,), -1, dtype=jnp.int64).at[
-            jnp.where(win, hidx, H)].set(line, mode="drop")
-        ftbl_t = jnp.zeros((H,), dtype=jnp.int64).at[
-            jnp.where(win, hidx, H)].max(t_free, mode="drop")
-        line_floor = jnp.maximum(
-            line_floor,
-            jnp.where(ftbl_line[hidx] == line, ftbl_t[hidx], 0))
+        if dense_tables:
+            win_oh = oh_hidx & win[:, None]
+            ftbl_line = jnp.max(
+                jnp.where(win_oh, line[:, None], jnp.int64(-1)), axis=0)
+            ftbl_t = jnp.max(jnp.where(win_oh, t_free[:, None], 0), axis=0)
+            line_floor = jnp.maximum(
+                line_floor,
+                jnp.where(_sel(oh_hidx, ftbl_line) == line,
+                          _sel(oh_hidx, ftbl_t), 0))
+        else:
+            ftbl_line = jnp.full((H,), -1, dtype=jnp.int64).at[
+                jnp.where(win, hidx, H)].set(line, mode="drop")
+            ftbl_t = jnp.zeros((H,), dtype=jnp.int64).at[
+                jnp.where(win, hidx, H)].max(t_free, mode="drop")
+            line_floor = jnp.maximum(
+                line_floor,
+                jnp.where(ftbl_line[hidx] == line, ftbl_t[hidx], 0))
         resolved = resolved | win
         return _i + 1, state, resolved, line_floor
 
@@ -491,8 +592,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     saturated = is_req & ~resolved
     c = state.counters
     state = state._replace(counters=c._replace(
-        dir_deferrals=c.dir_deferrals.at[
-            jnp.where(saturated, home, T)].add(1, mode="drop")))
+        dir_deferrals=c.dir_deferrals + _binsum(oh_home, saturated, 1)))
     return state
 
 
@@ -507,46 +607,52 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
     """
     T = params.num_tiles
     W = state.dir_sharers.shape[-1]
-    nctl = params.dram.num_controllers
+    A = params.directory.associativity
+    ndsets = params.directory.num_sets
     vhome = home_of_line(params, vtag)
-    vdset = ((vtag // nctl) % params.directory.num_sets).astype(jnp.int32)
-    dtags = state.dir_tags[vhome, vdset]        # [T, A]
-    dstate = state.dir_state[vhome, vdset]
+    vdset = dir_set_of_line(params, vtag)
+    vfidx = (vhome * ndsets + vdset).astype(jnp.int32)
+    dtags = state.dir_tags.reshape(-1, A)[vfidx]        # [T, A]
+    dstate = state.dir_state.reshape(-1, A)[vfidx]
     match = (dtags == vtag[:, None]) & (dstate != I) & valid[:, None]
     found = match.any(axis=1)
     way = jnp.argmax(match, axis=1).astype(jnp.int32)
     est = jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0]
     eowner = jnp.take_along_axis(
-        state.dir_owner[vhome, vdset], way[:, None], axis=1)[:, 0]
+        state.dir_owner.reshape(-1, A)[vfidx], way[:, None], axis=1)[:, 0]
+    esharers = jnp.take_along_axis(
+        state.dir_sharers.reshape(-1, A, W)[vfidx], way[:, None, None],
+        axis=1)[:, 0, :]                                 # [T, W]
 
     # Owner dropped its M line: entry -> I.
     drop_m = found & (est == M) & (eowner == tiles)
-    hm = jnp.where(drop_m, vhome, T).astype(jnp.int32)
-    state = state._replace(
-        dir_state=state.dir_state.at[hm, vdset, way].set(I, mode="drop"),
-        dir_owner=state.dir_owner.at[hm, vdset, way].set(-1, mode="drop"),
-        dir_sharers=state.dir_sharers.at[hm, vdset, way].set(
-            jnp.zeros((T, W), dtype=jnp.uint64), mode="drop"))
-
     # Sharer dropped its S line: clear its bit (subtract — commutative, so
     # distinct sharers of one entry may clear in the same batch).
     word = (tiles // 64).astype(jnp.int32)
     bit = jnp.uint64(1) << (tiles % 64).astype(jnp.uint64)
-    cur = state.dir_sharers[vhome, vdset, way, word]
+    woh = word[:, None] == jnp.arange(W, dtype=jnp.int32)[None, :]
+    cur = jnp.sum(jnp.where(woh, esharers, jnp.uint64(0)), axis=1,
+                  dtype=jnp.uint64)
     drop_s = found & (est == S) & ((cur & bit) != jnp.uint64(0))
+    # Last sharer gone -> entry I, so later evictions of the entry don't
+    # burn fan-out budget on an empty bitmap.  (Concurrent same-entry drops
+    # of one entry in this batch each still see the pre-batch bitmap, so a
+    # transient empty-S entry can remain; the evict_s gate tolerates that.)
+    left = esharers & ~jnp.where(woh, bit[:, None], jnp.uint64(0))
+    empty = (left == jnp.uint64(0)).all(axis=1)
+
+    to_i = drop_m | (drop_s & empty)
+    hi = jnp.where(to_i, vhome, T).astype(jnp.int32)
+    hm = jnp.where(drop_m, vhome, T).astype(jnp.int32)
     hs = jnp.where(drop_s, vhome, T).astype(jnp.int32)
+    state = state._replace(
+        dir_state=state.dir_state.at[hi, vdset, way].set(I, mode="drop"),
+        dir_owner=state.dir_owner.at[hm, vdset, way].set(-1, mode="drop"),
+        dir_sharers=state.dir_sharers.at[hm, vdset, way].set(
+            jnp.zeros((T, W), dtype=jnp.uint64), mode="drop"))
     state = state._replace(
         dir_sharers=state.dir_sharers.at[hs, vdset, way, word].add(
             jnp.uint64(0) - bit, mode="drop"))
-    # Last sharer gone -> entry I, so later evictions of the entry don't
-    # burn fan-out budget on an empty bitmap.  (Concurrent same-entry drops
-    # in one batch may leave a transient empty-S entry; the evict_s gate
-    # tolerates that.)
-    vsh = state.dir_sharers[vhome, vdset, way]          # [T, W]
-    empty = (vsh == jnp.uint64(0)).all(axis=1)
-    hz = jnp.where(drop_s & empty, vhome, T).astype(jnp.int32)
-    state = state._replace(
-        dir_state=state.dir_state.at[hz, vdset, way].set(I, mode="drop"))
     return state
 
 
