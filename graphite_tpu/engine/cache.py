@@ -3,23 +3,34 @@
 The reference's generic cache (common/tile/memory_subsystem/cache/cache.{h,cc},
 cache_set.{h,cc}, cache_line_info.{h,cc}) is a per-tile C++ object probed one
 access at a time under the tile's MMU lock.  Here one cache *level* across
-ALL tiles is three arrays shaped ``[num_tiles, sets, assoc]`` (tag, coherence
-state, LRU rank) and every operation is batched over the tile axis — one
-probe call services every tile's current access.
+ALL tiles is two arrays shaped ``[assoc, num_tiles, sets]`` — an int32 line
+tag and an int32 packed (coherence state | LRU rank) word — and every
+operation is batched over the tile axis; one probe call services every
+tile's current access.
+
+Layout notes (HBM-bandwidth-driven; the engine is memory-bound):
+  * the ASSOC axis leads: TPU tiles the minor two dims to (8, 128), so a
+    trailing assoc-sized axis pads 8-16x in memory AND bandwidth; with
+    [A, T, sets] the minor dims are large and pad-free.
+  * tags are int32 line ids — the frontend asserts addresses < 2^37, i.e.
+    line ids < 2^31 (the reference's IntPtr is 64-bit, but simulated
+    targets use <= 48-bit VAs; 37 bits cover every vendored workload).
+  * state+LRU share one word (state = bits 0-2, LRU rank = bits 3-8) so a
+    probe or fill touches two arrays, not three.
 
 Coherence states are shared between cache levels and the directory logic
 (reference: common/tile/memory_subsystem/cache/cache_state.h and
 directory_state.h):
   I=0 < S=1 < O=2 < E=3 < M=4 — ordered so "writable" is a comparison.
 
-Replacement: LRU rank array (0 = MRU), matching the reference's default
+Replacement: LRU rank (0 = MRU), matching the reference's default
 (lru_replacement_policy.cc); round_robin keeps a per-set pointer and is
 selected by config.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -30,25 +41,41 @@ from graphite_tpu.params import CacheParams
 # Coherence state codes (cache lines AND directory entries).
 I, S, O, E, M = 0, 1, 2, 3, 4
 
+_STATE_BITS = 3
+_STATE_MASK = (1 << _STATE_BITS) - 1
+
+
+def pack_meta(state, lru):
+    """state (int32) + LRU rank (int32) -> packed int32 word."""
+    return (jnp.asarray(state, jnp.int32)
+            | (jnp.asarray(lru, jnp.int32) << _STATE_BITS))
+
+
+def meta_state(meta: jnp.ndarray) -> jnp.ndarray:
+    return meta & _STATE_MASK
+
+
+def meta_lru(meta: jnp.ndarray) -> jnp.ndarray:
+    return meta >> _STATE_BITS
+
 
 class CacheArrays(NamedTuple):
-    """One cache level for all tiles: [T, sets, assoc] arrays."""
+    """One cache level for all tiles: [assoc, T, sets] arrays."""
 
-    tags: jnp.ndarray    # int64 line address; meaningful iff state != I
-    state: jnp.ndarray   # int32 coherence state
-    lru: jnp.ndarray     # int32 LRU rank, 0 = most recently used
+    tags: jnp.ndarray    # int32 line id; meaningful iff state != I
+    meta: jnp.ndarray    # int32 (state | lru << 3)
     rr_ptr: jnp.ndarray  # int32 [T, sets] round-robin victim pointer
 
 
 def make_cache(num_tiles: int, params: CacheParams) -> CacheArrays:
-    shape = (num_tiles, params.num_sets, params.associativity)
+    A = params.associativity
+    shape = (A, num_tiles, params.num_sets)
+    lru0 = jnp.broadcast_to(
+        jnp.arange(A, dtype=jnp.int32)[:, None, None], shape)
     return CacheArrays(
-        tags=jnp.zeros(shape, dtype=jnp.int64),
-        state=jnp.zeros(shape, dtype=jnp.int32),
-        lru=jnp.tile(
-            jnp.arange(params.associativity, dtype=jnp.int32),
-            (num_tiles, params.num_sets, 1)),
-        rr_ptr=jnp.zeros(shape[:2], dtype=jnp.int32),
+        tags=jnp.zeros(shape, dtype=jnp.int32),
+        meta=pack_meta(jnp.full(shape, I, dtype=jnp.int32), lru0),
+        rr_ptr=jnp.zeros(shape[1:], dtype=jnp.int32),
     )
 
 
@@ -58,6 +85,13 @@ def set_index(line: jnp.ndarray, num_sets: int) -> jnp.ndarray:
     return (line % num_sets).astype(jnp.int32)
 
 
+def _row_gather(arr: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
+    """[A, T, sets] x [T, sets] one-hot -> [A, T]: masked sum over sets
+    (exactly one set selected per tile, so the sum IS the row)."""
+    return jnp.sum(jnp.where(oh[None, :, :], arr, 0), axis=2,
+                   dtype=arr.dtype)
+
+
 class ProbeResult(NamedTuple):
     hit: jnp.ndarray       # [T] bool
     way: jnp.ndarray       # [T] int32 (valid iff hit)
@@ -65,54 +99,50 @@ class ProbeResult(NamedTuple):
     set_idx: jnp.ndarray   # [T] int32
 
 
-# Dense one-hot set addressing (see engine/dense.py for the TPU-lowering
-# rationale: indexed gather/scatter serializes per row; these don't).
-_set_onehot = dense.onehot
-_row_gather = dense.row_gather
-
-
 def probe(cache: CacheArrays, line: jnp.ndarray, num_sets: int) -> ProbeResult:
-    """Look up ``line`` ([T] int64, one per tile) in each tile's cache."""
+    """Look up ``line`` ([T] int, one per tile) in each tile's cache."""
     sidx = set_index(line, num_sets)
-    oh = _set_onehot(sidx, num_sets)
-    tags_set = _row_gather(cache.tags, oh)     # [T, A]
-    state_set = _row_gather(cache.state, oh)   # [T, A]
-    match = (tags_set == line[:, None]) & (state_set != I)
-    hit = match.any(axis=1)
-    way = jnp.argmax(match, axis=1).astype(jnp.int32)
-    st = jnp.where(hit, jnp.take_along_axis(
-        state_set, way[:, None], axis=1)[:, 0], I)
+    oh = dense.onehot(sidx, num_sets)
+    tags_set = _row_gather(cache.tags, oh)               # [A, T]
+    state_set = meta_state(_row_gather(cache.meta, oh))  # [A, T]
+    match = (tags_set == line[None, :].astype(jnp.int32)) & (state_set != I)
+    hit = match.any(axis=0)
+    way = jnp.argmax(match, axis=0).astype(jnp.int32)
+    st = jnp.where(hit, jnp.sum(jnp.where(match, state_set, 0), axis=0), I)
     return ProbeResult(hit=hit, way=way, state=st, set_idx=sidx)
 
 
 def _promote(ranks: jnp.ndarray, way: jnp.ndarray) -> jnp.ndarray:
-    """LRU rank row after promoting ``way`` to MRU (rank 0)."""
-    r_w = jnp.take_along_axis(ranks, way[:, None], axis=1)
-    return jnp.where(
-        jnp.arange(ranks.shape[1])[None, :] == way[:, None],
-        0, ranks + (ranks < r_w))
+    """[A, T] LRU ranks after promoting ``way`` ([T]) to MRU (rank 0)."""
+    A = ranks.shape[0]
+    way_oh = jnp.arange(A, dtype=jnp.int32)[:, None] == way[None, :]
+    r_w = jnp.sum(jnp.where(way_oh, ranks, 0), axis=0)
+    return jnp.where(way_oh, 0, ranks + (ranks < r_w[None, :]))
 
 
 def touch(cache: CacheArrays, set_idx: jnp.ndarray, way: jnp.ndarray,
           active: jnp.ndarray) -> CacheArrays:
     """Promote (set_idx, way) to MRU for tiles where ``active``."""
-    num_sets = cache.lru.shape[1]
-    oh = _set_onehot(set_idx, num_sets) & active[:, None]
-    ranks = _row_gather(cache.lru, oh)
-    promoted = _promote(ranks, way)
-    lru = jnp.where(oh[:, :, None], promoted[:, None, :], cache.lru)
-    return cache._replace(lru=lru)
+    num_sets = cache.meta.shape[2]
+    oh = dense.onehot(set_idx, num_sets) & active[:, None]
+    meta_row = _row_gather(cache.meta, oh)               # [A, T]
+    new_row = pack_meta(meta_state(meta_row),
+                        _promote(meta_lru(meta_row), way))
+    meta = jnp.where(oh[None, :, :], new_row[:, :, None], cache.meta)
+    return cache._replace(meta=meta)
 
 
 def set_state(cache: CacheArrays, set_idx: jnp.ndarray, way: jnp.ndarray,
               new_state: jnp.ndarray, active: jnp.ndarray) -> CacheArrays:
     """State transition on an existing line (dense masked rewrite)."""
-    A = cache.tags.shape[2]
-    oh = _set_onehot(set_idx, cache.tags.shape[1]) & active[:, None]
-    sel = oh[:, :, None] & (jnp.arange(A)[None, None, :] == way[:, None, None])
+    A = cache.tags.shape[0]
+    oh = dense.onehot(set_idx, cache.tags.shape[2]) & active[:, None]
+    way_oh = jnp.arange(A, dtype=jnp.int32)[:, None] == way[None, :]
+    sel = oh[None, :, :] & way_oh[:, :, None]
     ns = jnp.broadcast_to(
-        jnp.asarray(new_state, jnp.int32).reshape(-1, 1, 1), sel.shape)
-    return cache._replace(state=jnp.where(sel, ns, cache.state))
+        jnp.asarray(new_state, jnp.int32).reshape(1, -1, 1), sel.shape)
+    meta = jnp.where(sel, pack_meta(ns, meta_lru(cache.meta)), cache.meta)
+    return cache._replace(meta=meta)
 
 
 class FillResult(NamedTuple):
@@ -128,70 +158,75 @@ def fill(cache: CacheArrays, line: jnp.ndarray, new_state: jnp.ndarray,
     """Allocate ``line`` in its set, evicting invalid-first then by policy
     (reference: cache_set.cc replace() + lru_replacement_policy.cc).
     Returns the victim so the caller can model writeback/coherence."""
-    T, _, A = cache.tags.shape
+    A = cache.tags.shape[0]
     sidx = set_index(line, num_sets)
-    oh = _set_onehot(sidx, num_sets)
-    state_set = _row_gather(cache.state, oh)
-    tags_set = _row_gather(cache.tags, oh)
-    invalid = state_set == I
-    has_invalid = invalid.any(axis=1)
-    first_invalid = jnp.argmax(invalid, axis=1)
+    oh = dense.onehot(sidx, num_sets)
+    meta_row = _row_gather(cache.meta, oh)     # [A, T]
+    tags_row = _row_gather(cache.tags, oh)
+    state_row = meta_state(meta_row)
+    lru_row = meta_lru(meta_row)
+    invalid = state_row == I
+    has_invalid = invalid.any(axis=0)
+    first_invalid = jnp.argmax(invalid, axis=0)
     oh_act = oh & active[:, None]
     if replacement == "round_robin":
-        ptr = _row_gather(cache.rr_ptr[:, :, None], oh)[:, 0]
+        ptr = jnp.sum(jnp.where(oh, cache.rr_ptr, 0), axis=1)
         policy_way = ptr % A
         cache = cache._replace(
             rr_ptr=jnp.where(oh_act, ((ptr + 1) % A)[:, None],
                              cache.rr_ptr))
     else:
-        policy_way = jnp.argmax(_row_gather(cache.lru, oh), axis=1)
+        policy_way = jnp.argmax(lru_row, axis=0)
     way = jnp.where(has_invalid, first_invalid, policy_way).astype(jnp.int32)
 
-    victim_tag = jnp.take_along_axis(tags_set, way[:, None], axis=1)[:, 0]
+    way_oh = jnp.arange(A, dtype=jnp.int32)[:, None] == way[None, :]
+    victim_tag = jnp.sum(
+        jnp.where(way_oh, tags_row, 0), axis=0).astype(jnp.int64)
     victim_state = jnp.where(
-        active,
-        jnp.take_along_axis(state_set, way[:, None], axis=1)[:, 0], I)
+        active, jnp.sum(jnp.where(way_oh, state_row, 0), axis=0), I)
 
-    sel = oh_act[:, :, None] \
-        & (jnp.arange(A)[None, None, :] == way[:, None, None])
+    # One pass per array: install the tag, and write state+promoted LRU as
+    # a single packed row.
+    new_state_row = jnp.where(way_oh, jnp.asarray(new_state, jnp.int32)[None, :],
+                              state_row)
+    new_meta_row = pack_meta(new_state_row, _promote(lru_row, way))
     cache = cache._replace(
-        tags=jnp.where(sel, line[:, None, None], cache.tags),
-        state=jnp.where(
-            sel,
-            jnp.broadcast_to(
-                jnp.asarray(new_state, jnp.int32).reshape(-1, 1, 1),
-                sel.shape),
-            cache.state),
+        tags=jnp.where(oh_act[None, :, :] & way_oh[:, :, None],
+                       line[None, :, None].astype(jnp.int32), cache.tags),
+        meta=jnp.where(oh_act[None, :, :], new_meta_row[:, :, None],
+                       cache.meta),
     )
-    cache = touch(cache, sidx, way, active)
     return FillResult(cache=cache, way=way, victim_tag=victim_tag,
                       victim_state=victim_state)
 
 
-def invalidate_lines(cache: CacheArrays, tile_lines: jnp.ndarray,
-                     valid: jnp.ndarray, num_sets: int,
-                     downgrade_to: int = I) -> Tuple[CacheArrays, jnp.ndarray]:
-    """Coherence-driven state change of arbitrary (tile, line) pairs.
+def invalidate_by_value(cache: CacheArrays, lines: jnp.ndarray,
+                        valid: jnp.ndarray,
+                        downgrade_s: jnp.ndarray) -> CacheArrays:
+    """Coherence delivery of per-tile line lists in ONE pass over the cache.
 
-    ``tile_lines``: [K, 2] int64 rows of (tile, line); ``valid``: [K] bool.
-    Used for directory-initiated INV_REQ / WB_REQ delivery (reference:
-    l1_cache_cntlr / l2_cache_cntlr handleMsgFromDramDirectory paths).
-    Returns (cache, was_dirty [K]) — was_dirty reports lines found in M/O
-    (so the caller can model the writeback data message).
+    ``lines``: [T, J] int line ids addressed to each tile's own cache;
+    ``valid``: [T, J]; ``downgrade_s``: [T, J] bool — True downgrades the
+    matched line to S (owner WB_REQ), False invalidates to I.
+
+    A tag can only reside in its own set, so comparing every cached tag
+    against the J line values is exact and reads the tag array once (J
+    compares per element fuse into the single pass — the engine is
+    memory-bound, VPU compares are free).
     """
-    tiles = tile_lines[:, 0].astype(jnp.int32)
-    lines = tile_lines[:, 1]
-    sidx = set_index(lines, num_sets)
-    tags_set = cache.tags[tiles, sidx]    # [K, A]
-    state_set = cache.state[tiles, sidx]  # [K, A]
-    match = (tags_set == lines[:, None]) & (state_set != I) & valid[:, None]
-    way = jnp.argmax(match, axis=1).astype(jnp.int32)
-    found = match.any(axis=1)
-    st = jnp.take_along_axis(state_set, way[:, None], axis=1)[:, 0]
-    was_dirty = found & ((st == M) | (st == O))
-    way_eff = jnp.where(found, way, cache.tags.shape[2]).astype(jnp.int32)
-    new_state = jnp.where(
-        (downgrade_to != I) & (st >= S), downgrade_to, I).astype(jnp.int32)
-    cache = cache._replace(
-        state=cache.state.at[tiles, sidx, way_eff].set(new_state, mode="drop"))
-    return cache, was_dirty
+    J = lines.shape[1]
+    lines32 = lines.astype(jnp.int32)
+    state = meta_state(cache.meta)
+    live = state != I
+    hit_i = jnp.zeros(cache.tags.shape, dtype=bool)
+    hit_s = jnp.zeros(cache.tags.shape, dtype=bool)
+    for j in range(J):
+        m = live & (cache.tags == lines32[None, :, j, None]) \
+            & valid[None, :, j, None]
+        hit_s = hit_s | (m & downgrade_s[None, :, j, None])
+        hit_i = hit_i | (m & ~downgrade_s[None, :, j, None])
+    # I wins over S when both target the same line (an invalidate and a
+    # downgrade in one round) — matches serializing the invalidate last.
+    new_state = jnp.where(hit_i, I, jnp.where(hit_s & (state >= S), S, state))
+    meta = pack_meta(new_state, meta_lru(cache.meta))
+    return cache._replace(meta=jnp.where(hit_i | hit_s, meta, cache.meta))

@@ -24,7 +24,8 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 3  # v2: freq_ghz -> period_ps; v3: dir_deferrals counter
+_SCHEMA_VERSION = 4  # v3: dir_deferrals counter; v4: packed int32
+#   cache/dir metadata layout (tags int32, state|lru / state|owner|lru words)
 
 
 def _flatten_with_paths(state: SimState):

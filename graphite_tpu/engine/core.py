@@ -67,7 +67,7 @@ def local_advance(params: SimParams, state: SimState,
     N = trace.num_events
     line_bits = params.line_size.bit_length() - 1
     rows = jnp.arange(T)
-    chan_depth = state.ch_time.shape[2]
+    chan_depth = state.ch_time.shape[0]
     num_locks = state.lock_holder.shape[0]
     num_bars = state.bar_count.shape[0]
     mcp = mcp_tile(params)
@@ -77,11 +77,11 @@ def local_advance(params: SimParams, state: SimState,
         active = (~st.done) & (st.pend_kind == PEND_NONE) \
             & (st.clock < st.boundary) & (st.cursor < N)
         cur = jnp.minimum(st.cursor, N - 1)
-        ev = trace.meta[rows, cur]             # [T, 3] one fused gather
+        ev = trace.meta[:, rows, cur]          # [3, T] one fused gather
         addr = trace.addr[rows, cur]
-        op = jnp.where(active, ev[:, 0], EventOp.NOP)
-        arg = ev[:, 1]
-        arg2 = ev[:, 2]
+        op = jnp.where(active, ev[0], EventOp.NOP)
+        arg = ev[1]
+        arg2 = ev[2]
 
         # Per-tile clock periods (DVFS-aware), ps per cycle.
         p_core = _period(st, DVFSModule.CORE)
@@ -160,13 +160,14 @@ def local_advance(params: SimParams, state: SimState,
         # The reused ring slot holds the consuming recv's completion time
         # (written by resolve_recv): even when the count check shows space,
         # the message can't occupy the slot before the recv that freed it.
-        slot_oh = dst_oh[:, :, None] & dense.onehot(
-            slot_idx, chan_depth)[:, None, :]
+        slot_oh = (jnp.arange(chan_depth,
+                              dtype=jnp.int32)[:, None, None]
+                   == slot_idx[None, :, None]) & dst_oh[None, :, :]
         slot_freed = jnp.sum(
-            jnp.where(slot_oh, st.ch_time, 0), axis=(1, 2))
+            jnp.where(slot_oh, st.ch_time, 0), axis=(0, 2))
         arrival = jnp.maximum(st.clock + cycle_ps, slot_freed) + send_net_ps
-        send_sel = slot_oh & is_send[:, None, None]
-        ch_time = jnp.where(send_sel, arrival[:, None, None], st.ch_time)
+        send_sel = slot_oh & is_send[None, :, None]
+        ch_time = jnp.where(send_sel, arrival[None, :, None], st.ch_time)
         ch_sent = st.ch_sent + jnp.where(
             dst_oh & is_send[:, None], 1, 0).astype(st.ch_sent.dtype)
         dt_send = cycle_ps

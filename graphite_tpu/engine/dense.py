@@ -39,13 +39,6 @@ def sel(oh: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(jnp.where(oh, vals[None, :], 0), axis=1, dtype=vals.dtype)
 
 
-def row_gather(arr: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
-    """[R, n, ...] x [R, n] -> [R, ...]: masked sum over the bin axis
-    (exactly one bin selected per row, so the sum IS the row)."""
-    mask = oh.reshape(oh.shape + (1,) * (arr.ndim - 2))
-    return jnp.sum(jnp.where(mask, arr, 0), axis=1, dtype=arr.dtype)
-
-
 def binsum(oh: jnp.ndarray, mask: jnp.ndarray, val) -> jnp.ndarray:
     """Dense scatter-add: per-bin sum of val[r] over rows with mask.
 

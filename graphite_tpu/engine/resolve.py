@@ -33,7 +33,8 @@ from graphite_tpu.engine import queue_models
 from graphite_tpu.engine.core import _lat, _period, mcp_tile
 from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_EX_REQ, PEND_IFETCH, PEND_MUTEX, PEND_NONE,
-    PEND_RECV, PEND_SEND, PEND_SH_REQ, SimState)
+    PEND_RECV, PEND_SEND, PEND_SH_REQ, SimState, dir_meta_lru,
+    dir_meta_owner, dir_meta_state, dir_pack)
 from graphite_tpu.isa import DVFSModule
 from graphite_tpu.params import SimParams
 
@@ -42,6 +43,11 @@ I, S, M = cachemod.I, cachemod.S, cachemod.M
 # Control-message payload bytes (request/inv/ack packets; reference
 # ShmemMsg header, shmem_msg.h:12-29).
 CTRL_BYTES = 8
+
+# Per-target budget of point-to-point owner flush/downgrade deliveries per
+# conflict round (several requesters may name one owner tile); overflow
+# rows defer a round.
+J_OWN = 8
 
 
 def home_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
@@ -142,13 +148,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     mis-times a request.
     """
     T = params.num_tiles
-    W = state.dir_sharers.shape[-1]
+    W = state.dir_sharers.shape[0]
     A = params.directory.associativity
     K = min(params.max_inv_fanout_per_round, T)
-    # Election hash-table size: with up to T concurrent distinct keys the
-    # expected number of colliding pairs is ~T^2/2H; 64x keeps spurious
-    # one-round deferrals rare (<1% of requests) at 8 bytes/slot.
-    H = max(4096, 64 * T)
+    # Election hash-table size: keys are fmix64-mixed, so collisions are
+    # birthday-random — with up to T concurrent keys the expected number
+    # of colliding pairs is ~T^2/2H; 16x keeps spurious one-round
+    # deferrals rare while the dense [T, H] election stays small.
+    H = max(1024, 16 * T)
     rows = jnp.arange(T)
     line_bits = params.line_size.bit_length() - 1
     nctl = params.dram.num_controllers
@@ -214,12 +221,13 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
 
         # ---- directory-cache probe at (home, dset), via the flat
         # (home*ndsets + dset) index — one gather per field
-        dtags = state.dir_tags.reshape(-1, A)[fidx]          # [T, A]
-        dstate = state.dir_state.reshape(-1, A)[fidx]
-        match = (dtags == line[:, None]) & (dstate != I)
+        dtags = state.dir_tags.reshape(A, -1)[:, fidx].T     # [T, A]
+        dmeta = state.dir_meta.reshape(A, -1)[:, fidx].T
+        dstate = dir_meta_state(dmeta)
+        match = (dtags == line[:, None].astype(jnp.int32)) & (dstate != I)
         hit = match.any(axis=1)
         hway = jnp.argmax(match, axis=1).astype(jnp.int32)
-        dlru = state.dir_lru.reshape(-1, A)[fidx]
+        dlru = dir_meta_lru(dmeta)
         invalid = dstate == I
 
         # ---- victim-way assignment for allocating (miss) winners.  The
@@ -270,8 +278,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         evicting = misswin & jnp.take_along_axis(
             dstate != I, way[:, None], axis=1)[:, 0]
 
-        downer = state.dir_owner.reshape(-1, A)[fidx]        # [T, A]
-        dsharers = state.dir_sharers.reshape(-1, A, W)[fidx]  # [T, A, W]
+        downer = dir_meta_owner(dmeta)                        # [T, A]
+        dsharers = state.dir_sharers.reshape(
+            W, A, -1)[:, :, fidx].transpose(2, 1, 0)          # [T, A, W]
         entry_state = jnp.where(
             hit, jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
         entry_owner = jnp.where(
@@ -287,7 +296,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # of the victim's sharers/owner on directory-cache replacement —
         # dram_directory_cntlr replacement path; leaving them cached would
         # let a later request grant M while stale copies still hit).
-        vtag = jnp.take_along_axis(dtags, way[:, None], axis=1)[:, 0]
+        vtag = jnp.take_along_axis(
+            dtags, way[:, None], axis=1)[:, 0].astype(jnp.int64)
         vstate = jnp.where(
             evicting,
             jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
@@ -303,6 +313,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         act = dirmod.msi_transition(is_ex, rows, entry_state, entry_owner,
                                     entry_sharers, W)
         has_inv = win & (act.inv_targets != jnp.uint64(0)).any(axis=1)
+        owner = act.owner_tile
+        vown_c = jnp.maximum(vowner, 0)
 
         # ---- fan-out budget: at most K multicast deliveries per round,
         # granted in FCFS key order (not tile order) so a hot-spot round
@@ -310,15 +322,55 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         need_fan = has_inv | evict_s
         fan_keys = jnp.where(need_fan, packed, _BIG)
         kth = -jax.lax.top_k(-fan_keys, K)[0][K - 1]   # Kth-smallest key
-        sel = need_fan & (packed <= kth)
-        rank = queue_models._cumsum_doubling(sel.astype(jnp.int32)) - 1
-        fan_defer = need_fan & ~sel
-        win = win & ~fan_defer
-        has_inv = has_inv & ~fan_defer
-        evict_m = evict_m & ~fan_defer
-        evict_s = evict_s & ~fan_defer
-        evicting = evicting & ~fan_defer
+        sel0 = need_fan & (packed <= kth)
+        fan_defer = need_fan & ~sel0
+        win1 = win & ~fan_defer
 
+        # ---- owner-side delivery slots: at most J_OWN point-to-point
+        # flush/downgrade deliveries per TARGET tile per round (owner legs
+        # of current entries + victim-owner flushes — several requesters
+        # can name the same owner); rows past a target's budget defer
+        # their whole request a round, in FCFS key order (tile order above
+        # the dense-rank size cap).
+        owner_leg1 = act.owner_leg & win1
+        evict_m1 = evict_m & ~fan_defer
+        tgt2 = jnp.concatenate([owner, vown_c])
+        val2 = jnp.concatenate([owner_leg1, evict_m1])
+        key2 = jnp.concatenate([packed, packed])
+        oh_t2 = _oh(tgt2, T) & val2[:, None]              # [2T, T]
+        if 4 * T * T * T <= 8 * _DENSE_MAX_ELEMS:
+            earlier2 = key2[:, None] > key2[None, :]
+            posr = jnp.sum(earlier2[:, :, None] & oh_t2[None, :, :],
+                           axis=1, dtype=jnp.int32)       # [2T, T]
+        else:
+            c2 = jnp.cumsum(oh_t2.astype(jnp.int32), axis=0)
+            posr = c2 - oh_t2.astype(jnp.int32)
+        over2 = (oh_t2 & (posr >= J_OWN)).any(axis=1)     # [2T]
+        ow_defer = over2[:T] | over2[T:]
+        win = win1 & ~ow_defer
+        has_inv = has_inv & ~fan_defer & ~ow_defer
+        evict_m = evict_m1 & ~ow_defer
+        evict_s = evict_s & ~fan_defer & ~ow_defer
+        evicting = evicting & ~fan_defer & ~ow_defer
+        owner_leg = owner_leg1 & ~ow_defer
+        val2 = jnp.concatenate([owner_leg, evict_m])
+        oh_t2 = oh_t2 & val2[:, None]
+
+        # Per-target owner-delivery line lists [T, J_OWN] (dense build —
+        # surviving rows keep their unique slot rank < J_OWN).
+        oslot = oh_t2[:, :, None] & (
+            posr[:, :, None] == jnp.arange(J_OWN, dtype=jnp.int32)[None,
+                                                                   None, :])
+        lines2 = jnp.concatenate([line, vtag])
+        down2 = jnp.concatenate(
+            [act.owner_downgrade_to == S, jnp.zeros(T, dtype=bool)])
+        own_lines = jnp.sum(
+            jnp.where(oslot, lines2[:, None, None], 0), axis=0)   # [T, J]
+        own_valid = oslot.any(axis=0)
+        own_down = jnp.any(oslot & down2[:, None, None], axis=0)
+
+        sel = sel0 & ~ow_defer
+        rank = queue_models._cumsum_doubling(sel.astype(jnp.int32)) - 1
         # Selected fan-out rows, as a dense [K, T] slot-assignment mask
         # (oh_sr[k, t] <=> requester t owns fan-out slot k this round).
         oh_sr = sel[None, :] & (
@@ -355,7 +407,6 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         evict_ps = jnp.where(evict_s, jnp.sum(
             jnp.where(oh_sr, vic_ps_k[:, None], 0), axis=0), 0)
         # M-state victim: single-owner flush round trip.
-        vown_c = jnp.maximum(vowner, 0)
         oh_vown = _oh(vown_c, T)
         p_net_vown = _sel(oh_vown, p_net).astype(jnp.int32)
         p_l2_vown = _sel(oh_vown, p_l2).astype(jnp.int32)
@@ -375,8 +426,6 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # request is served.
         t_dir = arrive + dir_ps + jnp.where(evicting, evict_ps, 0)
 
-        owner = act.owner_tile
-        owner_leg = act.owner_leg & win
         oh_owner = _oh(owner, T)
         p_net_own = _sel(oh_owner, p_net).astype(jnp.int32)
         p_l2_own = _sel(oh_owner, p_l2).astype(jnp.int32)
@@ -411,31 +460,25 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         completion = t_data + reply_ps + l2_fill_ps + l1_fill_ps \
             + state.pend_extra
 
-        # ---- apply directory entry updates (scatter at home slices)
+        # ---- apply directory entry updates: merged whole-row writes.
+        # Several same-set winners per round are the common case (distinct
+        # ways by design), so the row written must reflect ALL of the
+        # set's installs: each winner computes the identical merged row —
+        # every touched way carries its toucher's new tag/state/owner/
+        # sharers; LRU ranks touched ways by touch recency (latest FCFS
+        # key = MRU = 0) with untouched ways following in pre-round
+        # relative order — and the colliding whole-row scatters agree.
         home_w = jnp.where(win, home, T).astype(jnp.int32)
-        state = state._replace(
-            dir_tags=state.dir_tags.at[home_w, dset, way].set(
-                line, mode="drop"),
-            dir_state=state.dir_state.at[home_w, dset, way].set(
-                act.new_state, mode="drop"),
-            dir_owner=state.dir_owner.at[home_w, dset, way].set(
-                act.new_owner, mode="drop"),
-            dir_sharers=state.dir_sharers.at[home_w, dset, way].set(
-                act.new_sharers, mode="drop"),
-        )
-        # Dir LRU: merged post-round ranks.  Several same-set winners per
-        # round are the common case (distinct ways by design), so the row
-        # written must reflect ALL of the set's touches: touched ways rank
-        # by touch recency (latest FCFS key = MRU = 0), untouched ways
-        # follow in their pre-round relative order.  Every winner of a set
-        # computes the identical row, so the colliding whole-row scatters
-        # agree.
-        wway_oh = win[None, :, None] & (
+        sw = same_hs[:, :, None] & win[None, :, None] & (
             way[None, :, None] == jnp.arange(A, dtype=jnp.int32)[None, None, :])
-        touched = jnp.any(same_hs[:, :, None] & wway_oh, axis=1)   # [T, A]
-        tkey = jnp.sum(
-            jnp.where(same_hs[:, :, None] & wway_oh,
-                      packed[None, :, None], 0), axis=1)           # [T, A]
+        touched = jnp.any(sw, axis=1)                               # [T, A]
+
+        def merge(vals, old):  # [T] per-winner value -> [T, A] merged row
+            m = jnp.sum(jnp.where(sw, vals[None, :, None], 0), axis=1,
+                        dtype=old.dtype)
+            return jnp.where(touched, m, old)
+
+        tkey = jnp.sum(jnp.where(sw, packed[None, :, None], 0), axis=1)
         n_touch = jnp.sum(touched, axis=1, dtype=jnp.int32)
         rank_t = jnp.sum(
             touched[:, None, :] & (tkey[:, None, :] > tkey[:, :, None]),
@@ -443,40 +486,52 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         rank_u = n_touch[:, None] + jnp.sum(
             ~touched[:, None, :] & (dlru[:, None, :] < dlru[:, :, None]),
             axis=2, dtype=jnp.int32)
-        new_lru_row = jnp.where(touched, rank_t, rank_u)
-        state = state._replace(
-            dir_lru=state.dir_lru.at[home_w, dset].set(
-                new_lru_row, mode="drop"))
+        row_tags = merge(line.astype(jnp.int32), dtags)
+        row_meta = dir_pack(
+            merge(act.new_state, dstate),
+            merge(act.new_owner, downer),
+            jnp.where(touched, rank_t, rank_u))
+        row_sharers = jnp.where(
+            touched[:, :, None],
+            jnp.sum(jnp.where(sw[:, :, :, None],
+                              act.new_sharers[None, :, None, :],
+                              jnp.uint64(0)), axis=1, dtype=jnp.uint64),
+            dsharers)
 
-        # ---- coherence-driven cache-state changes, one batched call per
-        # cache level: owner downgrades (current-entry M), victim-owner
-        # flushes, budgeted sharer invalidations, and victim-entry sharer
-        # invalidations.
+        arA = jnp.arange(A)[:, None]
+        arW = jnp.arange(W)[:, None, None]
+        state = state._replace(
+            dir_tags=state.dir_tags.at[arA, home_w[None, :],
+                                       dset[None, :]].set(
+                row_tags.T, mode="drop"),
+            dir_meta=state.dir_meta.at[arA, home_w[None, :],
+                                       dset[None, :]].set(
+                row_meta.T, mode="drop"),
+            dir_sharers=state.dir_sharers.at[
+                arW, arA[None], home_w[None, None, :],
+                dset[None, None, :]].set(
+                row_sharers.transpose(2, 1, 0), mode="drop"),
+        )
+
+        # ---- coherence-driven cache-state changes, one single-pass sweep
+        # per cache level over per-target line lists: owner downgrades
+        # (current-entry M), victim-owner flushes, budgeted sharer
+        # invalidations, and victim-entry sharer invalidations.
         line_sr = sr_sel(line)
         vtag_sr = sr_sel(vtag)
-        ktgt = jnp.broadcast_to(rows[None, :], (K, T)).reshape(-1)
-        pairs = jnp.concatenate([
-            jnp.stack([owner.astype(jnp.int64), line], axis=1),
-            jnp.stack([jnp.maximum(vowner, 0).astype(jnp.int64), vtag],
-                      axis=1),
-            jnp.stack([ktgt.astype(jnp.int64),
-                       jnp.broadcast_to(line_sr[:, None],
-                                        (K, T)).reshape(-1)], axis=1),
-            jnp.stack([ktgt.astype(jnp.int64),
-                       jnp.broadcast_to(vtag_sr[:, None],
-                                        (K, T)).reshape(-1)], axis=1),
-        ], axis=0)
-        pvalid = jnp.concatenate(
-            [owner_leg, evict_m, inv_bool.reshape(-1), vic_bool.reshape(-1)],
-            axis=0)
-        pdown = jnp.concatenate(
-            [act.owner_downgrade_to,
-             jnp.full(T + 2 * K * T, I, dtype=jnp.int32)], axis=0)
-        l2c, _ = cachemod.invalidate_lines(
-            state.l2, pairs, pvalid, params.l2.num_sets, pdown)
-        l1c, _ = cachemod.invalidate_lines(
-            state.l1d, pairs, pvalid, params.l1d.num_sets, pdown)
-        state = state._replace(l2=l2c, l1d=l1c)
+        dlv_lines = jnp.concatenate([
+            own_lines,
+            jnp.broadcast_to(line_sr[None, :], (T, K)),
+            jnp.broadcast_to(vtag_sr[None, :], (T, K))], axis=1)
+        dlv_valid = jnp.concatenate(
+            [own_valid, inv_bool.T, vic_bool.T], axis=1)
+        dlv_down = jnp.concatenate(
+            [own_down, jnp.zeros((T, 2 * K), dtype=bool)], axis=1)
+        state = state._replace(
+            l2=cachemod.invalidate_by_value(
+                state.l2, dlv_lines, dlv_valid, dlv_down),
+            l1d=cachemod.invalidate_by_value(
+                state.l1d, dlv_lines, dlv_valid, dlv_down))
 
         # ---- requester-side fills (L2 always; L1D or L1I by request kind)
         f2 = cachemod.fill(state.l2, line,
@@ -491,10 +546,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             oh_vhome, victim_dirty, dram_service_ps))
         # An evicted-from-L2 line also leaves L1 (inclusive hierarchy,
         # reference l2_cache_cntlr invalidation of L1 on eviction).
-        vpairs = jnp.stack([rows.astype(jnp.int64), f2.victim_tag], axis=1)
-        l1c, _ = cachemod.invalidate_lines(
-            state.l1d, vpairs, victim_live, params.l1d.num_sets, I)
-        state = state._replace(l1d=l1c)
+        state = state._replace(l1d=cachemod.invalidate_by_value(
+            state.l1d, f2.victim_tag[:, None], victim_live[:, None],
+            jnp.zeros((T, 1), dtype=bool)))
         # Notify the victim line's home directory (reference sends eviction
         # writebacks that downgrade the entry; silently dropping them left
         # stale owners/sharer bits that charge phantom coherence legs).
@@ -542,10 +596,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             + _binsum(oh_home, win, flits_data)
             + _binsum(oh_home, inv_count > 0, inv_count * flits_req),
             # Deferral events this round: way-slot collisions + fan-out
-            # budget overflow (a request deferred in N rounds counts N
-            # times; end-of-pass saturation is counted separately below).
+            # budget overflow + owner-delivery budget overflow (a request
+            # deferred in N rounds counts N times; end-of-pass saturation
+            # is counted separately below).
             dir_deferrals=c.dir_deferrals
-            + _binsum(oh_home, alloc_defer | fan_defer, 1),
+            + _binsum(oh_home, alloc_defer | fan_defer | ow_defer, 1),
         )
         state = state._replace(counters=c)
 
@@ -606,23 +661,27 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
     writeback messages into dram_directory_cntlr.)
     """
     T = params.num_tiles
-    W = state.dir_sharers.shape[-1]
+    W = state.dir_sharers.shape[0]
     A = params.directory.associativity
     ndsets = params.directory.num_sets
     vhome = home_of_line(params, vtag)
     vdset = dir_set_of_line(params, vtag)
     vfidx = (vhome * ndsets + vdset).astype(jnp.int32)
-    dtags = state.dir_tags.reshape(-1, A)[vfidx]        # [T, A]
-    dstate = state.dir_state.reshape(-1, A)[vfidx]
-    match = (dtags == vtag[:, None]) & (dstate != I) & valid[:, None]
+    dtags = state.dir_tags.reshape(A, -1)[:, vfidx].T   # [T, A]
+    dmeta = state.dir_meta.reshape(A, -1)[:, vfidx].T
+    dstate = dir_meta_state(dmeta)
+    match = (dtags == vtag[:, None].astype(jnp.int32)) \
+        & (dstate != I) & valid[:, None]
     found = match.any(axis=1)
     way = jnp.argmax(match, axis=1).astype(jnp.int32)
-    est = jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0]
-    eowner = jnp.take_along_axis(
-        state.dir_owner.reshape(-1, A)[vfidx], way[:, None], axis=1)[:, 0]
-    esharers = jnp.take_along_axis(
-        state.dir_sharers.reshape(-1, A, W)[vfidx], way[:, None, None],
-        axis=1)[:, 0, :]                                 # [T, W]
+    meta_way = jnp.take_along_axis(dmeta, way[:, None], axis=1)[:, 0]
+    est = dir_meta_state(meta_way)
+    eowner = dir_meta_owner(meta_way)
+    way_oh = jnp.arange(A, dtype=jnp.int32)[:, None] == way[None, :]
+    esharers = jnp.sum(
+        jnp.where(way_oh[None, :, :],
+                  state.dir_sharers.reshape(W, A, -1)[:, :, vfidx],
+                  jnp.uint64(0)), axis=1, dtype=jnp.uint64).T   # [T, W]
 
     # Owner dropped its M line: entry -> I.
     drop_m = found & (est == M) & (eowner == tiles)
@@ -645,13 +704,15 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
     hi = jnp.where(to_i, vhome, T).astype(jnp.int32)
     hm = jnp.where(drop_m, vhome, T).astype(jnp.int32)
     hs = jnp.where(drop_s, vhome, T).astype(jnp.int32)
+    arW = jnp.arange(W)[:, None]
     state = state._replace(
-        dir_state=state.dir_state.at[hi, vdset, way].set(I, mode="drop"),
-        dir_owner=state.dir_owner.at[hm, vdset, way].set(-1, mode="drop"),
-        dir_sharers=state.dir_sharers.at[hm, vdset, way].set(
-            jnp.zeros((T, W), dtype=jnp.uint64), mode="drop"))
+        dir_meta=state.dir_meta.at[way, hi, vdset].set(
+            dir_pack(I, -1, dir_meta_lru(meta_way)), mode="drop"),
+        dir_sharers=state.dir_sharers.at[
+            arW, way[None, :], hm[None, :], vdset[None, :]].set(
+            jnp.zeros((W, T), dtype=jnp.uint64), mode="drop"))
     state = state._replace(
-        dir_sharers=state.dir_sharers.at[hs, vdset, way, word].add(
+        dir_sharers=state.dir_sharers.at[word, way, hs, vdset].add(
             jnp.uint64(0) - bit, mode="drop"))
     return state
 
@@ -661,14 +722,14 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
 def resolve_recv(params: SimParams, state: SimState) -> SimState:
     T = params.num_tiles
     rows = jnp.arange(T)
-    D = state.ch_time.shape[2]
+    D = state.ch_time.shape[0]
     is_recv = state.pend_kind == PEND_RECV
     src = jnp.clip(state.pend_aux, 0, T - 1)
     sent = state.ch_sent[src, rows]
     recvd = state.ch_recvd[src, rows]
     avail = sent > recvd
     slot = recvd % D
-    arr = state.ch_time[src, rows, slot]
+    arr = state.ch_time[slot, src, rows]
     ok = is_recv & avail
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
     completion = jnp.maximum(state.pend_issue, arr) + cycle_ps
@@ -679,7 +740,7 @@ def resolve_recv(params: SimParams, state: SimState) -> SimState:
         # the slot's next writer (a send reusing it after a wrap) reads it
         # back as the slot-freed floor, so back-pressured sends can never
         # stamp arrivals that predate the recv that made room.
-        ch_time=state.ch_time.at[src_eff, rows, slot].set(
+        ch_time=state.ch_time.at[slot, src_eff, rows].set(
             completion, mode="drop"),
         counters=state.counters._replace(
             recvs=state.counters.recvs + jnp.where(ok, 1, 0)))
@@ -690,7 +751,7 @@ def resolve_send(params: SimParams, state: SimState) -> SimState:
     """Complete sends that were back-pressured by a full channel ring."""
     T = params.num_tiles
     rows = jnp.arange(T)
-    D = state.ch_time.shape[2]
+    D = state.ch_time.shape[0]
     is_send = state.pend_kind == PEND_SEND
     dst = jnp.clip(state.pend_aux, 0, T - 1)
     space = (state.ch_sent[rows, dst] - state.ch_recvd[rows, dst]) < D
@@ -703,12 +764,12 @@ def resolve_send(params: SimParams, state: SimState) -> SimState:
     # Floor at the time the reused ring slot was actually freed (the
     # consuming recv's completion, stored into the slot by resolve_recv) —
     # a back-pressured send cannot complete before the recv that made room.
-    freed = state.ch_time[rows, dst, slot]
+    freed = state.ch_time[slot, rows, dst]
     completion = jnp.maximum(state.pend_issue, freed) + cycle_ps
     arrival = completion + net_ps
     src_eff = jnp.where(ok, rows, T).astype(jnp.int32)
     state = state._replace(
-        ch_time=state.ch_time.at[src_eff, dst, slot].set(arrival, mode="drop"),
+        ch_time=state.ch_time.at[slot, src_eff, dst].set(arrival, mode="drop"),
         ch_sent=state.ch_sent.at[src_eff, dst].add(1, mode="drop"),
         counters=state.counters._replace(
             sends=state.counters.sends + jnp.where(ok, 1, 0),

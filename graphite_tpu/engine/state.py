@@ -89,15 +89,16 @@ def make_counters(num_tiles: int) -> Counters:
 class TraceArrays(NamedTuple):
     """Device-resident trace (see events/schema.py for field semantics).
 
-    The int32 event fields are interleaved into one [T, N, 3] array
+    The int32 event fields are stacked into one [3, T, N] array
     (op, arg, arg2) beside the int64 address array, so the per-slot fetch
-    is two contiguous gathers per tile instead of four — gathers on this
-    hardware cost per *operation*, not per element — without widening the
-    narrow fields to int64 (which would grow the resident trace 60%).
+    is two gathers per tile instead of four — gathers on this hardware
+    cost per *operation*, not per element.  The field axis LEADS (TPU pads
+    the minor two dims to (8, 128) tiles; a trailing length-3 axis would
+    pad the resident trace ~42x).
     """
 
     addr: jnp.ndarray  # [T, N] int64 byte address
-    meta: jnp.ndarray  # [T, N, 3] int32: (op, arg, arg2)
+    meta: jnp.ndarray  # [3, T, N] int32: (op, arg, arg2)
 
     @property
     def num_events(self) -> int:
@@ -106,13 +107,40 @@ class TraceArrays(NamedTuple):
     @classmethod
     def from_trace(cls, trace: Trace) -> "TraceArrays":
         import numpy as np
+        addr = np.asarray(trace.addr, dtype=np.int64)
+        if addr.max(initial=0) >= (1 << 37):
+            raise ValueError(
+                "trace addresses must be < 2^37 (int32 line-id layout)")
         meta = np.stack([
             np.asarray(trace.ops, dtype=np.int32),
             np.asarray(trace.arg, dtype=np.int32),
             np.asarray(trace.arg2, dtype=np.int32),
-        ], axis=2)
-        return cls(addr=jnp.asarray(np.asarray(trace.addr, dtype=np.int64)),
-                   meta=jnp.asarray(meta))
+        ], axis=0)
+        return cls(addr=jnp.asarray(addr), meta=jnp.asarray(meta))
+
+
+_DIR_OWNER_BITS = 13   # owner+1, supports up to 8191 tiles
+_DIR_OWNER_SHIFT = 3
+_DIR_LRU_SHIFT = _DIR_OWNER_SHIFT + _DIR_OWNER_BITS
+
+
+def dir_pack(state, owner, lru):
+    """Pack directory-entry (state, owner tile, LRU rank) into one int32."""
+    return (jnp.asarray(state, jnp.int32)
+            | ((jnp.asarray(owner, jnp.int32) + 1) << _DIR_OWNER_SHIFT)
+            | (jnp.asarray(lru, jnp.int32) << _DIR_LRU_SHIFT))
+
+
+def dir_meta_state(meta):
+    return meta & 7
+
+
+def dir_meta_owner(meta):
+    return ((meta >> _DIR_OWNER_SHIFT) & ((1 << _DIR_OWNER_BITS) - 1)) - 1
+
+
+def dir_meta_lru(meta):
+    return meta >> _DIR_LRU_SHIFT
 
 
 class SimState(NamedTuple):
@@ -148,11 +176,14 @@ class SimState(NamedTuple):
     period_ps: jnp.ndarray    # [T, NUM_DVFS_MODULES] int32 ps per cycle
 
     # -- directory slices (home-tile-indexed; reference: directory_cache.cc)
-    dir_tags: jnp.ndarray     # [T, dsets, dassoc] int64 line
-    dir_state: jnp.ndarray    # [T, dsets, dassoc] int32 (I/S/M dir states)
-    dir_owner: jnp.ndarray    # [T, dsets, dassoc] int32 owner tile (M/O state)
-    dir_sharers: jnp.ndarray  # [T, dsets, dassoc, W] uint64 sharer bitmap words
-    dir_lru: jnp.ndarray      # [T, dsets, dassoc] int32
+    # Entry metadata is packed into one int32 word (see dir_pack/
+    # dir_meta_*): the engine is HBM-bound and three separate int32 arrays
+    # tripled the per-round directory traffic.  Small structural axes
+    # (assoc, bitmap words) lead so the minor dims stay (8,128)-tile-sized.
+    dir_tags: jnp.ndarray     # [dassoc, T, dsets] int32 line id
+    dir_meta: jnp.ndarray     # [dassoc, T, dsets] int32 packed
+    #   (state bits 0-2 | owner+1 bits 3-15 | lru bits 16+)
+    dir_sharers: jnp.ndarray  # [W, dassoc, T, dsets] uint64 sharer bitmaps
 
     # -- memory controllers (reference: dram_cntlr.h + dram_perf_model.h)
     dram_free_at: jnp.ndarray  # [T] int64 — FCFS queue-model horizon
@@ -166,7 +197,8 @@ class SimState(NamedTuple):
     # -- user-network channels (CAPI; reference: common/user/capi.cc)
     ch_sent: jnp.ndarray       # [T, T] int32 messages sent src->dst
     ch_recvd: jnp.ndarray      # [T, T] int32 messages consumed
-    ch_time: jnp.ndarray       # [T, T, D] int64 arrival-time ring buffer
+    ch_time: jnp.ndarray       # [D, T, T] int64 arrival-time ring buffer
+    #   (slot axis leads — see the directory layout note)
 
     counters: Counters
 
@@ -183,9 +215,13 @@ def make_state(params: SimParams,
                max_barriers: int = 16,
                channel_depth: int = 0) -> SimState:
     T = params.num_tiles
+    if T > (1 << _DIR_OWNER_BITS) - 2:
+        raise ValueError(
+            f"num_tiles {T} exceeds the packed directory owner field "
+            f"({(1 << _DIR_OWNER_BITS) - 2} max); widen _DIR_OWNER_BITS")
     if channel_depth <= 0:
         channel_depth = params.channel_depth
-    d_shape = (T, params.directory.num_sets, params.directory.associativity)
+    d_shape = (params.directory.associativity, T, params.directory.num_sets)
     W = (T + 63) // 64  # sharer bitmap words (full_map)
     return SimState(
         clock=jnp.zeros(T, dtype=jnp.int64),
@@ -202,13 +238,14 @@ def make_state(params: SimParams,
         l1d=cachemod.make_cache(T, params.l1d),
         l2=cachemod.make_cache(T, params.l2),
         period_ps=jnp.asarray(init_periods(params)),
-        dir_tags=jnp.zeros(d_shape, dtype=jnp.int64),
-        dir_state=jnp.zeros(d_shape, dtype=jnp.int32),
-        dir_owner=jnp.full(d_shape, -1, dtype=jnp.int32),
-        dir_sharers=jnp.zeros(d_shape + (W,), dtype=jnp.uint64),
-        dir_lru=jnp.tile(
-            jnp.arange(params.directory.associativity, dtype=jnp.int32),
-            d_shape[:2] + (1,)),
+        dir_tags=jnp.zeros(d_shape, dtype=jnp.int32),
+        dir_meta=dir_pack(
+            jnp.zeros(d_shape, dtype=jnp.int32),
+            jnp.full(d_shape, -1, dtype=jnp.int32),
+            jnp.broadcast_to(
+                jnp.arange(params.directory.associativity,
+                           dtype=jnp.int32)[:, None, None], d_shape)),
+        dir_sharers=jnp.zeros((W,) + d_shape, dtype=jnp.uint64),
         dram_free_at=jnp.zeros(T, dtype=jnp.int64),
         lock_holder=jnp.zeros(max_mutexes, dtype=jnp.int32),
         lock_free_at=jnp.zeros(max_mutexes, dtype=jnp.int64),
@@ -216,6 +253,6 @@ def make_state(params: SimParams,
         bar_time=jnp.zeros(max_barriers, dtype=jnp.int64),
         ch_sent=jnp.zeros((T, T), dtype=jnp.int32),
         ch_recvd=jnp.zeros((T, T), dtype=jnp.int32),
-        ch_time=jnp.zeros((T, T, channel_depth), dtype=jnp.int64),
+        ch_time=jnp.zeros((channel_depth, T, T), dtype=jnp.int64),
         counters=make_counters(T),
     )

@@ -33,15 +33,31 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(np.array(devices), (axis,))
 
 
-def tile_sharding(mesh: Mesh, num_tiles: int):
-    """Sharding-spec pytree builder: arrays with a leading tile axis are
-    split over the mesh; global arrays (sync objects, the quantum boundary)
-    are replicated."""
+# Tile-axis position per engine array field.  Engine arrays keep small
+# structural dims (assoc ways, bitmap words, channel slots, event fields)
+# LEADING so the minor two dims stay large — TPU pads the minor dims to
+# (8, 128) tiles, and a trailing assoc-sized axis wastes 8-16x memory and
+# bandwidth — which puts the tile axis at position 0, 1, or 2 depending on
+# the array.  Matching by field name (not by axis size) avoids sharding a
+# structural axis that happens to equal the tile count (e.g. channel_depth
+# == num_tiles).
+_TILE_AXIS_BY_FIELD = {
+    "tags": 1, "meta": 1,            # CacheArrays [A, T, sets] / trace
+    "dir_tags": 1, "dir_meta": 1,    # [A, T, dsets]
+    "dir_sharers": 2,                # [W, A, T, dsets]
+    "ch_time": 1,                    # [D, T, T]
+}
 
-    def spec_for(leaf: Any):
+
+def tile_sharding(mesh: Mesh, num_tiles: int):
+    """Sharding-spec builder: each array's tile axis is split over the
+    mesh; global arrays (sync objects, the quantum boundary) replicate."""
+
+    def spec_for(name: str, leaf: Any):
         shape = np.shape(leaf)
-        if len(shape) >= 1 and shape[0] == num_tiles:
-            return NamedSharding(mesh, P(TILE_AXIS))
+        ax = _TILE_AXIS_BY_FIELD.get(name, 0)
+        if len(shape) > ax and shape[ax] == num_tiles:
+            return NamedSharding(mesh, P(*([None] * ax + [TILE_AXIS])))
         return NamedSharding(mesh, P())
 
     return spec_for
@@ -50,5 +66,13 @@ def tile_sharding(mesh: Mesh, num_tiles: int):
 def shard_pytree(tree: Any, mesh: Mesh, num_tiles: int) -> Any:
     """Place a pytree (SimState / TraceArrays) onto the mesh, tile-sharded."""
     spec = tile_sharding(mesh, num_tiles)
-    return jax.tree_util.tree_map(
-        lambda leaf: jax.device_put(leaf, spec(leaf)), tree)
+
+    def place(path, leaf):
+        name = ""
+        for p in reversed(path):
+            if hasattr(p, "name"):
+                name = p.name
+                break
+        return jax.device_put(leaf, spec(name, leaf))
+
+    return jax.tree_util.tree_map_with_path(place, tree)

@@ -180,7 +180,7 @@ def test_send_is_nonblocking_recv_blocks():
     st = run_local(params, trace, warm_icache=False)
     assert bool(st.done[0])
     assert int(st.ch_sent[0, 1]) == 1
-    assert int(st.ch_time[0, 1, 0]) > 0
+    assert int(st.ch_time[0, 0, 1]) > 0   # [slot, src, dst]
     from graphite_tpu.engine.state import PEND_RECV
     assert int(st.pend_kind[1]) == PEND_RECV
 

@@ -58,8 +58,9 @@ def test_shared_readers_sharer_bitmap():
     assert int(c["dir_sh_req"].sum()) == 8 * 8
     assert int(c["dir_invalidations"].sum()) == 0
     # the directory must now record all 8 tiles as sharers of each line
-    dstate = np.asarray(sim.state.dir_state)
-    dsharers = np.asarray(sim.state.dir_sharers)
+    from graphite_tpu.engine.state import dir_meta_state
+    dstate = np.asarray(dir_meta_state(sim.state.dir_meta))  # [A, T, dsets]
+    dsharers = np.moveaxis(np.asarray(sim.state.dir_sharers), 0, -1)
     shared_entries = dstate == cachemod.S
     assert shared_entries.sum() == 8  # 8 lines tracked, one entry each
     bits = dsharers[shared_entries]
@@ -107,8 +108,9 @@ def test_write_invalidates_sharers():
     # tile 0's final read downgraded writer 2's M entry: S, sharers {0, 2},
     # one owner writeback
     assert int(c["dir_writebacks"].sum()) == 1
-    dstate = np.asarray(sim.state.dir_state)
-    dsharers = np.asarray(sim.state.dir_sharers)
+    from graphite_tpu.engine.state import dir_meta_state
+    dstate = np.asarray(dir_meta_state(sim.state.dir_meta))  # [A, T, dsets]
+    dsharers = np.moveaxis(np.asarray(sim.state.dir_sharers), 0, -1)
     s_entries = dstate == cachemod.S
     assert s_entries.sum() == 1
     assert dsharers[s_entries][0, 0] == np.uint64(0b101)
