@@ -24,8 +24,8 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 4  # v3: dir_deferrals counter; v4: packed int32
-#   cache/dir metadata layout (tags int32, state|lru / state|owner|lru words)
+_SCHEMA_VERSION = 5  # v4: packed int32 cache/dir metadata layout;
+#   v5: iocoom load/store queue state (lq/sq rings)
 
 
 def _flatten_with_paths(state: SimState):

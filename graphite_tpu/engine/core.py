@@ -83,6 +83,30 @@ def local_advance(params: SimParams, state: SimState,
         arg = ev[1]
         arg2 = ev[2]
 
+        # iocoom drain points: atomics, sync/thread ops, DONE (and branches
+        # unless speculative loads are on) wait for every outstanding
+        # load/store completion (reference: iocoom_core_model.cc LQ/SQ
+        # synchronization; [core/iocoom] carbon_sim.cfg:180-186).
+        if params.core.model == "iocoom":
+            drain_t = jnp.maximum(jnp.max(st.lq_ready, axis=0),
+                                  jnp.max(st.sq_ready, axis=0))
+            drain_op = ((op == EventOp.ATOMIC)
+                        | (op == EventOp.BARRIER_WAIT)
+                        | (op == EventOp.MUTEX_LOCK)
+                        | (op == EventOp.MUTEX_UNLOCK)
+                        | (op == EventOp.RECV)
+                        | (op == EventOp.SEND)
+                        | (op == EventOp.SYNC)
+                        | (op == EventOp.SPAWN)
+                        | (op == EventOp.DVFS_SET)
+                        | (op == EventOp.DONE))
+            if not params.core.speculative_loads:
+                drain_op = drain_op | (op == EventOp.BRANCH)
+            clk = jnp.where(drain_op, jnp.maximum(st.clock, drain_t),
+                            st.clock)
+        else:
+            clk = st.clock
+
         # Per-tile clock periods (DVFS-aware), ps per cycle.
         p_core = _period(st, DVFSModule.CORE)
         p_l1i = _period(st, DVFSModule.L1_ICACHE)
@@ -120,14 +144,23 @@ def local_advance(params: SimParams, state: SimState,
 
         # ------------------------------------------------------- BRANCH
         is_br = op == EventOp.BRANCH
-        bidx = (addr % params.core.bp_size).astype(jnp.int32)
-        pred = st.bp_table[rows, bidx]
         taken = arg != 0
-        correct = pred == taken
-        dt_br = jnp.where(correct, cycle_ps,
-                          _lat(params.core.bp_mispredict_penalty, p_core)) + l1i_ps
-        bp_sel = is_br[:, None] & dense.onehot(bidx, params.core.bp_size)
-        bp_table = jnp.where(bp_sel, taken[:, None], st.bp_table)
+        if params.core.bp_type == "none":
+            # No predictor modeled: a branch is a plain 1-cycle
+            # instruction (reference: branch_predictor.cc factory returns
+            # NULL and no mispredict penalty is ever charged).
+            correct = jnp.ones_like(is_br)
+            dt_br = cycle_ps + l1i_ps
+            bp_table = st.bp_table
+        else:
+            bidx = (addr % params.core.bp_size).astype(jnp.int32)
+            pred = st.bp_table[rows, bidx]
+            correct = pred == taken
+            dt_br = jnp.where(
+                correct, cycle_ps,
+                _lat(params.core.bp_mispredict_penalty, p_core)) + l1i_ps
+            bp_sel = is_br[:, None] & dense.onehot(bidx, params.core.bp_size)
+            bp_table = jnp.where(bp_sel, taken[:, None], st.bp_table)
 
         # ------------------------------------------------- MEMORY OPERANDS
         is_rd = op == EventOp.MEM_READ
@@ -165,7 +198,7 @@ def local_advance(params: SimParams, state: SimState,
                    == slot_idx[None, :, None]) & dst_oh[None, :, :]
         slot_freed = jnp.sum(
             jnp.where(slot_oh, st.ch_time, 0), axis=(0, 2))
-        arrival = jnp.maximum(st.clock + cycle_ps, slot_freed) + send_net_ps
+        arrival = jnp.maximum(clk + cycle_ps, slot_freed) + send_net_ps
         send_sel = slot_oh & is_send[None, :, None]
         ch_time = jnp.where(send_sel, arrival[None, :, None], st.ch_time)
         ch_sent = st.ch_sent + jnp.where(
@@ -186,14 +219,14 @@ def local_advance(params: SimParams, state: SimState,
         bar_count = st.bar_count + dense.binsum(
             bar_oh, is_bar, 1).astype(st.bar_count.dtype)
         bar_time = jnp.maximum(st.bar_time, dense.binmax(
-            bar_oh, is_bar, st.clock + to_mcp_ps, NEG))
+            bar_oh, is_bar, clk + to_mcp_ps, NEG))
         # unlock: release the mutex at MCP-arrival time; requester pays the
         # round trip (SyncClient blocks on the ack, sync_client.h:10-30)
         lock_id = jnp.clip(arg, 0, num_locks - 1)
         ul_oh = dense.onehot(lock_id, num_locks) & is_unlock[:, None]
         lock_holder = jnp.where(ul_oh.any(axis=0), 0, st.lock_holder)
         lock_free_at = jnp.maximum(st.lock_free_at, dense.binmax(
-            ul_oh, is_unlock, st.clock + to_mcp_ps + cycle_ps, NEG))
+            ul_oh, is_unlock, clk + to_mcp_ps + cycle_ps, NEG))
         dt_unlock = 2 * to_mcp_ps + 2 * cycle_ps
 
         # ------------------------------------------------ SIMPLE/DYNAMIC OPS
@@ -224,12 +257,12 @@ def local_advance(params: SimParams, state: SimState,
         dt = jnp.where(is_spawn, dt_spawn, dt)
         dt = jnp.where(is_dvfs, dt_dvfs, dt)
 
-        new_clock = st.clock + dt
+        new_clock = clk + dt
         new_clock = jnp.where(
-            is_stall, jnp.maximum(st.clock, addr), new_clock)
+            is_stall, jnp.maximum(clk, addr), new_clock)
         new_clock = jnp.where(
             is_sync,
-            jnp.maximum(st.clock, addr) + _lat(jnp.maximum(arg, 0), p_core),
+            jnp.maximum(clk, addr) + _lat(jnp.maximum(arg, 0), p_core),
             new_clock)
 
         # ------------------------------------------------- blocking events
@@ -246,11 +279,17 @@ def local_advance(params: SimParams, state: SimState,
         pend_addr = jnp.where(is_bar | is_lock, jnp.int64(arg),
                               jnp.where(send_block, jnp.int64(jnp.maximum(arg, 0)),
                                         jnp.where(blocked, addr, st.pend_addr)))
-        issue = st.clock + jnp.where(
+        issue = clk + jnp.where(
             comp_block, l1i_ps + l2_tag_ps,
             jnp.where(mem_rem, l1d_ps + l2_tag_ps, cycle_ps))
         pend_issue = jnp.where(blocked, issue, st.pend_issue)
-        pend_aux = jnp.where(blocked, arg2, st.pend_aux)
+        # For memory requests pend_aux carries the atomic flag (resolve
+        # needs it: iocoom lets plain loads/stores complete out-of-order
+        # but atomics wait their full round trip).
+        pend_aux = jnp.where(blocked,
+                             jnp.where(mem_rem, is_at.astype(jnp.int32),
+                                       arg2),
+                             st.pend_aux)
         # Local cost still owed once the remote part resolves: a blocked
         # COMPUTE block's execution + fetch time (minus the remotely
         # fetched first line, which resolve prices), an atomic's RMW cycle.

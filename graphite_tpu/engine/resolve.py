@@ -604,7 +604,47 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         )
         state = state._replace(counters=c)
 
-        state = _unblock(state, win, completion, sync=False)
+        # ---- core-model unblock semantics.  simple: the in-order core
+        # stalls until the data arrives (SimpleCoreModel).  iocoom: plain
+        # load/store misses only hold the core until issue + 1 cycle (the
+        # LQ/SQ entry tracks the priced completion; drain points in
+        # local_advance wait for it), floored at the reused ring slot's
+        # previous completion — a full queue backpressures.  Atomics and
+        # i-fetches always wait in full.  (Reference:
+        # iocoom_core_model.cc:78- load queue / store buffer.)
+        if params.core.model == "iocoom":
+            is_atomic = state.pend_aux != 0
+            is_load = win & (state.pend_kind == PEND_SH_REQ) & ~is_atomic
+            is_store = win & (state.pend_kind == PEND_EX_REQ) & ~is_atomic
+            LQE = state.lq_ready.shape[0]
+            SQE = state.sq_ready.shape[0]
+            lq_oh = dense.onehot(state.lq_next % LQE, LQE).T \
+                & is_load[None, :]                           # [LQE, T]
+            sq_oh = dense.onehot(state.sq_next % SQE, SQE).T \
+                & is_store[None, :]
+            lq_floor = jnp.sum(jnp.where(lq_oh, state.lq_ready, 0), axis=0)
+            sq_floor = jnp.sum(jnp.where(sq_oh, state.sq_ready, 0), axis=0)
+            if not params.core.multiple_outstanding_rfos:
+                # One outstanding RFO: a store miss waits for every prior
+                # store's completion before issuing its own.
+                sq_floor = jnp.maximum(
+                    sq_floor, jnp.max(state.sq_ready, axis=0))
+            unpark = jnp.where(
+                is_load, jnp.maximum(issue + cycle_ps, lq_floor),
+                jnp.where(is_store,
+                          jnp.maximum(issue + cycle_ps, sq_floor),
+                          completion))
+            state = state._replace(
+                lq_ready=jnp.where(lq_oh, completion[None, :],
+                                   state.lq_ready),
+                sq_ready=jnp.where(sq_oh, completion[None, :],
+                                   state.sq_ready),
+                lq_next=state.lq_next + is_load,
+                sq_next=state.sq_next + is_store)
+        else:
+            unpark = completion
+
+        state = _unblock(state, win, unpark, sync=False)
 
         # ---- serialization floor for still-pending same-line requests:
         # per-line winner's data-availability time, via the same hash table

@@ -185,6 +185,17 @@ class SimState(NamedTuple):
     #   (state bits 0-2 | owner+1 bits 3-15 | lru bits 16+)
     dir_sharers: jnp.ndarray  # [W, dassoc, T, dsets] uint64 sharer bitmaps
 
+    # -- iocoom load/store queues (reference: iocoom_core_model.cc:78-;
+    # completion-time rings — a load/store miss parks the tile only until
+    # the resolve phase PRICES it; under iocoom the core then continues
+    # from shortly after issue while the completion occupies a queue slot,
+    # and drain points (atomics, sync ops, DONE, branches without
+    # speculative loads) wait for the queues' max completion)
+    lq_ready: jnp.ndarray      # [LQE, T] int64 completion times
+    sq_ready: jnp.ndarray      # [SQE, T] int64
+    lq_next: jnp.ndarray       # [T] int32 ring cursor
+    sq_next: jnp.ndarray       # [T] int32
+
     # -- memory controllers (reference: dram_cntlr.h + dram_perf_model.h)
     dram_free_at: jnp.ndarray  # [T] int64 — FCFS queue-model horizon
 
@@ -246,6 +257,12 @@ def make_state(params: SimParams,
                 jnp.arange(params.directory.associativity,
                            dtype=jnp.int32)[:, None, None], d_shape)),
         dir_sharers=jnp.zeros((W,) + d_shape, dtype=jnp.uint64),
+        lq_ready=jnp.zeros((params.core.load_queue_entries, T),
+                           dtype=jnp.int64),
+        sq_ready=jnp.zeros((params.core.store_queue_entries, T),
+                           dtype=jnp.int64),
+        lq_next=jnp.zeros(T, dtype=jnp.int32),
+        sq_next=jnp.zeros(T, dtype=jnp.int32),
         dram_free_at=jnp.zeros(T, dtype=jnp.int64),
         lock_holder=jnp.zeros(max_mutexes, dtype=jnp.int32),
         lock_free_at=jnp.zeros(max_mutexes, dtype=jnp.int64),

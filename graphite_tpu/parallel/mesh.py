@@ -46,6 +46,7 @@ _TILE_AXIS_BY_FIELD = {
     "dir_tags": 1, "dir_meta": 1,    # [A, T, dsets]
     "dir_sharers": 2,                # [W, A, T, dsets]
     "ch_time": 1,                    # [D, T, T]
+    "lq_ready": 1, "sq_ready": 1,    # [entries, T]
 }
 
 

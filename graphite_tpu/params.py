@@ -361,6 +361,31 @@ class SimParams:
         if len(sizes) != 1:
             raise ConfigError(
                 f"cache line sizes must agree across L1I/L1D/L2, got {sizes}")
+        # A config-compatible simulator that quietly simulates a different
+        # machine is worse than one that refuses: every selectable model
+        # variant that the engine does not implement yet fails loudly here
+        # instead of silently running the implemented one.
+        def _check(what, value, supported):
+            if value not in supported:
+                raise ConfigError(
+                    f"{what} '{value}' is not implemented "
+                    f"(supported: {sorted(supported)})")
+        _check("tile core model", self.core.model, {"simple", "iocoom"})
+        if self.core.model == "iocoom":
+            _positive(self.core.load_queue_entries,
+                      "core/iocoom/num_load_queue_entries")
+            _positive(self.core.store_queue_entries,
+                      "core/iocoom/num_store_queue_entries")
+        _check("caching_protocol/type", self.protocol,
+               {"pr_l1_pr_l2_dram_directory_msi"})
+        _check("dram_directory/directory_type",
+               self.directory.directory_type, {"full_map"})
+        _check("network/user model", self.net_user.model,
+               {"magic", "emesh_hop_counter"})
+        _check("network/memory model", self.net_memory.model,
+               {"magic", "emesh_hop_counter"})
+        _check("branch_predictor/type", self.core.bp_type,
+               {"one_bit", "none"})
 
     def module_freq_ghz(self, module: DVFSModule) -> float:
         """Initial frequency of a module from its DVFS domain."""
