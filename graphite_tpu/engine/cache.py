@@ -155,7 +155,9 @@ class FillResult(NamedTuple):
 def fill(cache: CacheArrays, line: jnp.ndarray, new_state: jnp.ndarray,
          active: jnp.ndarray, num_sets: int,
          replacement: str = "lru") -> FillResult:
-    """Allocate ``line`` in its set, evicting invalid-first then by policy
+    """Install ``line`` in its set: upgrade in place when the line is
+    already resident (an S->M / O->M upgrade reply must not duplicate the
+    tag in another way), else allocate invalid-first then by policy
     (reference: cache_set.cc replace() + lru_replacement_policy.cc).
     Returns the victim so the caller can model writeback/coherence."""
     A = cache.tags.shape[0]
@@ -165,6 +167,9 @@ def fill(cache: CacheArrays, line: jnp.ndarray, new_state: jnp.ndarray,
     tags_row = _row_gather(cache.tags, oh)
     state_row = meta_state(meta_row)
     lru_row = meta_lru(meta_row)
+    resident = (tags_row == line[None, :].astype(jnp.int32)) & (state_row != I)
+    has_res = resident.any(axis=0)
+    res_way = jnp.argmax(resident, axis=0)
     invalid = state_row == I
     has_invalid = invalid.any(axis=0)
     first_invalid = jnp.argmax(invalid, axis=0)
@@ -173,22 +178,30 @@ def fill(cache: CacheArrays, line: jnp.ndarray, new_state: jnp.ndarray,
         ptr = jnp.sum(jnp.where(oh, cache.rr_ptr, 0), axis=1)
         policy_way = ptr % A
         cache = cache._replace(
-            rr_ptr=jnp.where(oh_act, ((ptr + 1) % A)[:, None],
-                             cache.rr_ptr))
+            rr_ptr=jnp.where(oh_act & ~has_res[:, None],
+                             ((ptr + 1) % A)[:, None], cache.rr_ptr))
     else:
         policy_way = jnp.argmax(lru_row, axis=0)
-    way = jnp.where(has_invalid, first_invalid, policy_way).astype(jnp.int32)
+    way = jnp.where(
+        has_res, res_way,
+        jnp.where(has_invalid, first_invalid, policy_way)).astype(jnp.int32)
 
     way_oh = jnp.arange(A, dtype=jnp.int32)[:, None] == way[None, :]
     victim_tag = jnp.sum(
         jnp.where(way_oh, tags_row, 0), axis=0).astype(jnp.int64)
     victim_state = jnp.where(
-        active, jnp.sum(jnp.where(way_oh, state_row, 0), axis=0), I)
+        active & ~has_res,
+        jnp.sum(jnp.where(way_oh, state_row, 0), axis=0), I)
 
     # One pass per array: install the tag, and write state+promoted LRU as
-    # a single packed row.
-    new_state_row = jnp.where(way_oh, jnp.asarray(new_state, jnp.int32)[None, :],
-                              state_row)
+    # a single packed row.  An in-place upgrade never downgrades the
+    # resident copy (an SH fill racing a local M/O copy keeps the copy).
+    res_state = jnp.sum(jnp.where(resident, state_row, 0), axis=0)
+    eff_state = jnp.where(has_res,
+                          jnp.maximum(jnp.asarray(new_state, jnp.int32),
+                                      res_state),
+                          jnp.asarray(new_state, jnp.int32))
+    new_state_row = jnp.where(way_oh, eff_state[None, :], state_row)
     new_meta_row = pack_meta(new_state_row, _promote(lru_row, way))
     cache = cache._replace(
         tags=jnp.where(oh_act[None, :, :] & way_oh[:, :, None],
@@ -202,12 +215,15 @@ def fill(cache: CacheArrays, line: jnp.ndarray, new_state: jnp.ndarray,
 
 def invalidate_by_value(cache: CacheArrays, lines: jnp.ndarray,
                         valid: jnp.ndarray,
-                        downgrade_s: jnp.ndarray) -> CacheArrays:
+                        down_state: jnp.ndarray) -> CacheArrays:
     """Coherence delivery of per-tile line lists in ONE pass over the cache.
 
     ``lines``: [T, J] int line ids addressed to each tile's own cache;
-    ``valid``: [T, J]; ``downgrade_s``: [T, J] bool — True downgrades the
-    matched line to S (owner WB_REQ), False invalidates to I.
+    ``valid``: [T, J]; ``down_state``: [T, J] int32 — the state the matched
+    line drops to: I invalidates (INV/FLUSH_REQ), S or O downgrade an owner
+    copy (WB_REQ; MOSI owners keep O).  A delivery never raises a line's
+    state; the lowest target wins when several deliveries match one line
+    (matches serializing the strictest request last).
 
     A tag can only reside in its own set, so comparing every cached tag
     against the J line values is exact and reads the tag array once (J
@@ -218,15 +234,10 @@ def invalidate_by_value(cache: CacheArrays, lines: jnp.ndarray,
     lines32 = lines.astype(jnp.int32)
     state = meta_state(cache.meta)
     live = state != I
-    hit_i = jnp.zeros(cache.tags.shape, dtype=bool)
-    hit_s = jnp.zeros(cache.tags.shape, dtype=bool)
+    tgt = state
     for j in range(J):
         m = live & (cache.tags == lines32[None, :, j, None]) \
             & valid[None, :, j, None]
-        hit_s = hit_s | (m & downgrade_s[None, :, j, None])
-        hit_i = hit_i | (m & ~downgrade_s[None, :, j, None])
-    # I wins over S when both target the same line (an invalidate and a
-    # downgrade in one round) — matches serializing the invalidate last.
-    new_state = jnp.where(hit_i, I, jnp.where(hit_s & (state >= S), S, state))
-    meta = pack_meta(new_state, meta_lru(cache.meta))
-    return cache._replace(meta=jnp.where(hit_i | hit_s, meta, cache.meta))
+        tgt = jnp.where(m, jnp.minimum(tgt, down_state[None, :, j, None]),
+                        tgt)
+    return cache._replace(meta=pack_meta(tgt, meta_lru(cache.meta)))

@@ -1,9 +1,10 @@
-"""Directory coherence: sharer bitmaps and the MSI transition function.
+"""Directory coherence: sharer bitmaps and the MSI/MOSI transition functions.
 
-Rebuilds the reference's DRAM-directory controller FSM (reference:
+Rebuilds the reference's DRAM-directory controller FSMs (reference:
 common/tile/memory_subsystem/pr_l1_pr_l2_dram_directory_msi/
-dram_directory_cntlr.cc:44-369 — EX_REQ at :239-, SH_REQ at :315-) as a
-*pure function over request batches*: given the directory entry state for K
+dram_directory_cntlr.cc:44-369 — EX_REQ at :239-, SH_REQ at :315-; MOSI
+variant pr_l1_pr_l2_dram_directory_mosi/dram_directory_cntlr.cc) as *pure
+functions over request batches*: given the directory entry state for K
 in-flight requests, produce the new entry state plus the set of coherence
 actions (owner writeback/flush leg, sharer invalidations, DRAM data read)
 whose latencies the resolve phase prices.
@@ -15,8 +16,11 @@ limitless, reference common/tile/memory_subsystem/directory_schemes/) are
 expressed as a cap on tracked sharers + an overflow broadcast policy and
 layer on the same arrays.
 
-Directory entry states (reference: directory_state.h): U(ncached)=0,
-S(hared)=1, M(odified)=2 — we reuse the cache-state codes I/S/M.
+Directory entry states (reference: directory_state.h): UNCACHED, SHARED,
+OWNED (MOSI only), MODIFIED — we reuse the cache-state codes I/S/O/M.
+In MOSI the owner of an O entry keeps a dirty copy and forwards data to
+readers instead of writing back to DRAM (the point of the O state); its
+bit is also set in the sharer bitmap, so invalidation fan-outs reach it.
 """
 
 from __future__ import annotations
@@ -25,7 +29,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from graphite_tpu.engine.cache import I, M, S
+from graphite_tpu.engine.cache import I, M, O, S
 
 # ------------------------------------------------------------- bitmaps
 
@@ -75,6 +79,17 @@ class MsiActions(NamedTuple):
     dram_write: jnp.ndarray    # bool — writeback reaches DRAM (off critical path)
 
 
+def transition(protocol_kind: str, is_ex: jnp.ndarray, requester: jnp.ndarray,
+               state: jnp.ndarray, owner: jnp.ndarray, sharers: jnp.ndarray,
+               num_words: int) -> MsiActions:
+    """Dispatch the directory FSM by (static) protocol kind — the factory
+    boundary of MemoryManager::createMMU (memory_manager.cc:29-52)."""
+    if protocol_kind == "mosi":
+        return mosi_transition(is_ex, requester, state, owner, sharers,
+                               num_words)
+    return msi_transition(is_ex, requester, state, owner, sharers, num_words)
+
+
 def msi_transition(is_ex: jnp.ndarray, requester: jnp.ndarray,
                    state: jnp.ndarray, owner: jnp.ndarray,
                    sharers: jnp.ndarray, num_words: int) -> MsiActions:
@@ -121,6 +136,72 @@ def msi_transition(is_ex: jnp.ndarray, requester: jnp.ndarray,
     dram_read = ~owner_leg
     dram_write = owner_leg  # WB/FLUSH data lands in DRAM (reference
     #                         retrieveDataAndSendToL2Cache writes through)
+    return MsiActions(
+        new_state=new_state.astype(jnp.int32),
+        new_owner=new_owner.astype(jnp.int32),
+        new_sharers=new_sharers,
+        owner_leg=owner_leg,
+        owner_tile=jnp.maximum(owner, 0).astype(jnp.int32),
+        owner_downgrade_to=owner_downgrade,
+        inv_targets=inv_targets,
+        dram_read=dram_read,
+        dram_write=dram_write,
+    )
+
+
+def mosi_transition(is_ex: jnp.ndarray, requester: jnp.ndarray,
+                    state: jnp.ndarray, owner: jnp.ndarray,
+                    sharers: jnp.ndarray, num_words: int) -> MsiActions:
+    """The MOSI directory FSM (reference:
+    pr_l1_pr_l2_dram_directory_mosi/dram_directory_cntlr.cc).
+
+    Differences from MSI:
+      SH_REQ on M: owner downgrades M->O and FORWARDS the data (WB_REQ
+                   without DRAM write); entry M -> O, owner kept, sharer
+                   bitmap = {owner, req}.
+      SH_REQ on O: owner (already O) forwards data again; req joins the
+                   sharer bitmap.  No DRAM traffic at all.
+      EX_REQ on O: FLUSH owner (O -> I) + invalidate the other sharers;
+                   entry -> M owner=req, data from the old owner.
+      Owner upgrading its own O line (EX, requester == owner): invalidate
+      the other sharers only, no data movement.
+    Dirty data reaches DRAM only on cache eviction of an M/O line, never
+    on a directory transition.
+    """
+    req_bit = make_tile_bit(requester, num_words)
+    own_bit = make_tile_bit(jnp.maximum(owner, 0), num_words)
+    has_live_owner = ((state == M) | (state == O)) & (owner >= 0)
+    has_owner = has_live_owner & (owner != requester)
+    req_is_owner = has_live_owner & (owner == requester)
+
+    # --- SH_REQ outcomes
+    sh_state = jnp.where(state == I, S,
+                         jnp.where((state == M) | (state == O), O, S))
+    sh_owner = jnp.where((state == M) | (state == O), owner, -1)
+    sh_sharers = sharers | req_bit
+    sh_sharers = jnp.where((state == M)[:, None],
+                           own_bit | req_bit, sh_sharers)
+
+    # --- EX_REQ outcomes
+    ex_state = jnp.full_like(state, M)
+    ex_sharers = req_bit
+    ex_owner = requester.astype(jnp.int32)
+    # Invalidate every other sharer; the current owner (if distinct from
+    # the requester) gets the flush leg instead of a plain INV.
+    inv_targets = jnp.where(
+        (is_ex & ((state == S) | (state == O)))[:, None],
+        sharers & ~req_bit & ~jnp.where(has_owner[:, None], own_bit,
+                                        jnp.uint64(0)),
+        jnp.zeros_like(sharers))
+
+    new_state = jnp.where(is_ex, ex_state, sh_state)
+    new_owner = jnp.where(is_ex, ex_owner, sh_owner)
+    new_sharers = jnp.where(is_ex[:, None], ex_sharers, sh_sharers)
+
+    owner_leg = has_owner
+    owner_downgrade = jnp.where(is_ex, I, O).astype(jnp.int32)
+    dram_read = ~has_owner & ~req_is_owner
+    dram_write = jnp.zeros_like(owner_leg)   # O defers writeback to eviction
     return MsiActions(
         new_state=new_state.astype(jnp.int32),
         new_owner=new_owner.astype(jnp.int32),

@@ -38,7 +38,7 @@ from graphite_tpu.engine.state import (
 from graphite_tpu.isa import DVFSModule
 from graphite_tpu.params import SimParams
 
-I, S, M = cachemod.I, cachemod.S, cachemod.M
+I, S, O, M = cachemod.I, cachemod.S, cachemod.O, cachemod.M
 
 # Control-message payload bytes (request/inv/ack packets; reference
 # ShmemMsg header, shmem_msg.h:12-29).
@@ -307,11 +307,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         evict_m = evicting & (vstate == M) & (vowner >= 0)
         # Empty-S entries (every sharer already dropped the line silently)
         # need no invalidation traffic — don't burn a fan-out slot on them.
-        evict_s = evicting & (vstate == S) \
+        # O-state victims (MOSI) carry their owner in the sharer bitmap, so
+        # the same multicast invalidates owner + sharers; the owner's dirty
+        # data additionally reaches DRAM (occupancy + latency max below).
+        evict_s = evicting & ((vstate == S) | (vstate == O)) \
             & (vsharers != jnp.uint64(0)).any(axis=1)
 
-        act = dirmod.msi_transition(is_ex, rows, entry_state, entry_owner,
-                                    entry_sharers, W)
+        act = dirmod.transition(params.protocol_kind, is_ex, rows,
+                                entry_state, entry_owner, entry_sharers, W)
         has_inv = win & (act.inv_targets != jnp.uint64(0)).any(axis=1)
         owner = act.owner_tile
         vown_c = jnp.maximum(vowner, 0)
@@ -352,6 +355,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         evict_m = evict_m1 & ~ow_defer
         evict_s = evict_s & ~fan_defer & ~ow_defer
         evicting = evicting & ~fan_defer & ~ow_defer
+        evict_o = evicting & (vstate == O) & (vowner >= 0)
         owner_leg = owner_leg1 & ~ow_defer
         val2 = jnp.concatenate([owner_leg, evict_m])
         oh_t2 = oh_t2 & val2[:, None]
@@ -363,11 +367,12 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                                                                    None, :])
         lines2 = jnp.concatenate([line, vtag])
         down2 = jnp.concatenate(
-            [act.owner_downgrade_to == S, jnp.zeros(T, dtype=bool)])
+            [act.owner_downgrade_to, jnp.full(T, I, dtype=jnp.int32)])
         own_lines = jnp.sum(
             jnp.where(oslot, lines2[:, None, None], 0), axis=0)   # [T, J]
         own_valid = oslot.any(axis=0)
-        own_down = jnp.any(oslot & down2[:, None, None], axis=0)
+        own_tgt = jnp.sum(jnp.where(oslot, down2[:, None, None], 0),
+                          axis=0, dtype=jnp.int32)
 
         sel = sel0 & ~ow_defer
         rank = queue_models._cumsum_doubling(sel.astype(jnp.int32)) - 1
@@ -419,6 +424,10 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 params.line_size + CTRL_BYTES,
                 p_net_vown, params.mesh_width)
         evict_ps = jnp.where(evict_m, evict_m_ps, evict_ps)
+        # O-state victim (MOSI): sharer-invalidation multicast AND the
+        # owner's dirty-data flush leg — whichever completes later.
+        evict_ps = jnp.where(evict_o, jnp.maximum(evict_ps, evict_m_ps),
+                             evict_ps)
 
         # ---- latency assembly (SURVEY.md 3.3's round trips, analytically)
         arrive = jnp.maximum(issue + net_req, line_floor)
@@ -443,10 +452,13 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                               jnp.full(T, dram_service_ps), need_read,
                               state.dram_free_at)
         dram_ready = q.start + dram_access_ps + dram_service_ps
-        # Writebacks (owner-leg flushes, dirty victim evictions) occupy the
-        # controller off the critical path (write buffer): occupancy only.
+        # Writebacks (owner-leg flushes that reach DRAM, dirty victim
+        # evictions) occupy the controller off the critical path (write
+        # buffer): occupancy only.  MOSI owner forwards skip DRAM entirely
+        # (act.dram_write False); O-victim flushes do land there.
+        dram_wb = (act.dram_write & win) | evict_m | evict_o
         state = state._replace(dram_free_at=q.free_at + _binsum(
-            oh_home, owner_leg | evict_m, dram_service_ps))
+            oh_home, dram_wb, dram_service_ps))
 
         t_data = t_dir + owner_ps
         t_data = jnp.maximum(t_data, jnp.where(need_read, dram_ready, 0))
@@ -525,20 +537,21 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             jnp.broadcast_to(vtag_sr[None, :], (T, K))], axis=1)
         dlv_valid = jnp.concatenate(
             [own_valid, inv_bool.T, vic_bool.T], axis=1)
-        dlv_down = jnp.concatenate(
-            [own_down, jnp.zeros((T, 2 * K), dtype=bool)], axis=1)
+        dlv_tgt = jnp.concatenate(
+            [own_tgt, jnp.full((T, 2 * K), I, dtype=jnp.int32)], axis=1)
         state = state._replace(
             l2=cachemod.invalidate_by_value(
-                state.l2, dlv_lines, dlv_valid, dlv_down),
+                state.l2, dlv_lines, dlv_valid, dlv_tgt),
             l1d=cachemod.invalidate_by_value(
-                state.l1d, dlv_lines, dlv_valid, dlv_down))
+                state.l1d, dlv_lines, dlv_valid, dlv_tgt))
 
         # ---- requester-side fills (L2 always; L1D or L1I by request kind)
         f2 = cachemod.fill(state.l2, line,
                            jnp.where(is_ex, M, S).astype(jnp.int32),
                            win, params.l2.num_sets, params.l2.replacement)
         state = state._replace(l2=f2.cache)
-        victim_dirty = win & (f2.victim_state == M)
+        victim_dirty = win & ((f2.victim_state == M)
+                              | (f2.victim_state == O))
         victim_live = win & (f2.victim_state != I)
         victim_home = home_of_line(params, f2.victim_tag)
         oh_vhome = _oh(victim_home, T)
@@ -548,7 +561,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # reference l2_cache_cntlr invalidation of L1 on eviction).
         state = state._replace(l1d=cachemod.invalidate_by_value(
             state.l1d, f2.victim_tag[:, None], victim_live[:, None],
-            jnp.zeros((T, 1), dtype=bool)))
+            jnp.full((T, 1), I, dtype=jnp.int32)))
         # Notify the victim line's home directory (reference sends eviction
         # writebacks that downgrade the entry; silently dropping them left
         # stale owners/sharer bits that charge phantom coherence legs).
@@ -578,11 +591,13 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             dir_invalidations=c.dir_invalidations
             + _binsum(oh_home, inv_count > 0, inv_count),
             dir_writebacks=c.dir_writebacks
-            + _binsum(oh_home, owner_leg | evict_m, 1),
+            + _binsum(oh_home, owner_leg | evict_m | evict_o, 1),
+            dir_forwards=c.dir_forwards
+            + _binsum(oh_home, owner_leg & ~act.dram_write, 1),
             dir_evictions=c.dir_evictions + _binsum(oh_home, evicting, 1),
             dram_reads=c.dram_reads + _binsum(oh_home, need_read, 1),
             dram_writes=c.dram_writes
-            + _binsum(oh_home, owner_leg | evict_m, 1)
+            + _binsum(oh_home, dram_wb, 1)
             + _binsum(oh_vhome, victim_dirty, 1),
             net_mem_pkts=c.net_mem_pkts
             + jnp.where(win, 1, 0)                    # request
@@ -693,10 +708,12 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
 
 def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
                       vstate, valid) -> SimState:
-    """Tell the home directory a tile silently dropped ``vtag`` from its L2.
+    """Tell the home directory a tile dropped ``vtag`` from its L2.
 
-    M-owner entries become I (the dirty data went to DRAM); the tile's
-    sharer bit clears via a commutative subtract so concurrent drops of
+    M-owner entries become I (the dirty data went to DRAM); an O owner's
+    drop (MOSI) clears the owner and leaves the remaining sharers in S (or
+    I when none remain) — its dirty data also went to DRAM; a plain
+    sharer's bit clears via a commutative subtract so concurrent drops of
     different sharers of the same line all land.  (Reference: eviction
     writeback messages into dram_directory_cntlr.)
     """
@@ -725,14 +742,19 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
 
     # Owner dropped its M line: entry -> I.
     drop_m = found & (est == M) & (eowner == tiles)
-    # Sharer dropped its S line: clear its bit (subtract — commutative, so
-    # distinct sharers of one entry may clear in the same batch).
+    # Owner dropped its O line (MOSI): owner cleared, sharers remain in S.
+    drop_o = found & (est == O) & (eowner == tiles)
+    # Sharer dropped its S copy (incl. a non-owner sharer of an O entry):
+    # clear its bit (subtract — commutative, so distinct sharers of one
+    # entry may clear in the same batch).
     word = (tiles // 64).astype(jnp.int32)
     bit = jnp.uint64(1) << (tiles % 64).astype(jnp.uint64)
     woh = word[:, None] == jnp.arange(W, dtype=jnp.int32)[None, :]
     cur = jnp.sum(jnp.where(woh, esharers, jnp.uint64(0)), axis=1,
                   dtype=jnp.uint64)
-    drop_s = found & (est == S) & ((cur & bit) != jnp.uint64(0))
+    has_bit = (cur & bit) != jnp.uint64(0)
+    drop_s = found & has_bit \
+        & ((est == S) | ((est == O) & (eowner != tiles)))
     # Last sharer gone -> entry I, so later evictions of the entry don't
     # burn fan-out budget on an empty bitmap.  (Concurrent same-entry drops
     # of one entry in this batch each still see the pre-batch bitmap, so a
@@ -740,10 +762,12 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
     left = esharers & ~jnp.where(woh, bit[:, None], jnp.uint64(0))
     empty = (left == jnp.uint64(0)).all(axis=1)
 
-    to_i = drop_m | (drop_s & empty)
+    to_i = drop_m | ((drop_s | drop_o) & empty)
+    to_s = drop_o & ~empty
     hi = jnp.where(to_i, vhome, T).astype(jnp.int32)
+    ho = jnp.where(to_s, vhome, T).astype(jnp.int32)
     hm = jnp.where(drop_m, vhome, T).astype(jnp.int32)
-    hs = jnp.where(drop_s, vhome, T).astype(jnp.int32)
+    hs = jnp.where(drop_s | drop_o, vhome, T).astype(jnp.int32)
     arW = jnp.arange(W)[:, None]
     state = state._replace(
         dir_meta=state.dir_meta.at[way, hi, vdset].set(
@@ -751,6 +775,9 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
         dir_sharers=state.dir_sharers.at[
             arW, way[None, :], hm[None, :], vdset[None, :]].set(
             jnp.zeros((W, T), dtype=jnp.uint64), mode="drop"))
+    state = state._replace(
+        dir_meta=state.dir_meta.at[way, ho, vdset].set(
+            dir_pack(S, -1, dir_meta_lru(meta_way)), mode="drop"))
     state = state._replace(
         dir_sharers=state.dir_sharers.at[word, way, hs, vdset].add(
             jnp.uint64(0) - bit, mode="drop"))

@@ -104,6 +104,7 @@ class SimSummary:
         row("Exclusive Requests", agg["dir_ex_req"])
         row("Invalidations", agg["dir_invalidations"])
         row("Writebacks", agg["dir_writebacks"])
+        row("Cache-to-Cache Forwards", agg["dir_forwards"])
         row("Evictions", agg["dir_evictions"])
         row("Conflict-Round Deferrals", agg["dir_deferrals"])
         lines.append("[dram]")

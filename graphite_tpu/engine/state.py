@@ -62,6 +62,8 @@ class Counters(NamedTuple):
     dir_ex_req: jnp.ndarray
     dir_invalidations: jnp.ndarray   # INV_REQ messages sent from this slice
     dir_writebacks: jnp.ndarray      # WB/FLUSH data returns to this slice
+    dir_forwards: jnp.ndarray        # owner cache-to-cache forwards that
+    #   skipped DRAM (MOSI O-state forwards; always 0 under MSI)
     dir_evictions: jnp.ndarray       # directory-cache entry evictions
     dir_deferrals: jnp.ndarray       # deferral events: one per round a
     #   request is pushed back by the way-slot election or the fan-out

@@ -356,6 +356,11 @@ class SimParams:
     def line_size(self) -> int:
         return self.l2.line_size
 
+    @property
+    def protocol_kind(self) -> str:
+        """Directory FSM family of the selected protocol: 'msi' | 'mosi'."""
+        return "mosi" if self.protocol.endswith("_mosi") else "msi"
+
     def __post_init__(self):
         sizes = {self.l1i.line_size, self.l1d.line_size, self.l2.line_size}
         if len(sizes) != 1:
@@ -377,7 +382,8 @@ class SimParams:
             _positive(self.core.store_queue_entries,
                       "core/iocoom/num_store_queue_entries")
         _check("caching_protocol/type", self.protocol,
-               {"pr_l1_pr_l2_dram_directory_msi"})
+               {"pr_l1_pr_l2_dram_directory_msi",
+                "pr_l1_pr_l2_dram_directory_mosi"})
         _check("dram_directory/directory_type",
                self.directory.directory_type, {"full_map"})
         _check("network/user model", self.net_user.model,
