@@ -8,9 +8,11 @@ common/network/network_model.h:39-207 and common/network/models/):
   * ``emesh_hop_counter`` — analytical 2D electrical mesh: XY hop count x
     (router + link delay) + flit serialization, no contention
     (network_model_emesh_hop_counter.cc:143).
-  * ``emesh_hop_by_hop`` — adds per-link contention; the contention term is
-    applied by the resolve phase via link queue horizons (engine/resolve.py);
-    the zero-load component comes from here.
+  * ``emesh_hop_by_hop`` — per-link contention, modeled in
+    engine/noc_flight.py (hop-by-hop flights over FCFS link horizons
+    carried in ``SimState.link_free_mem``); resolve prices every memory-
+    network unicast leg through it when this model is selected.  The
+    functions here still supply the zero-load forms for multicasts.
 
 All functions are elementwise over [K]-shaped tile-id arrays so one call
 prices every in-flight packet at once.  Tiles are laid out row-major on a

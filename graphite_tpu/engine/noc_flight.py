@@ -1,0 +1,110 @@
+"""Contended hop-by-hop mesh traversal (emesh_hop_by_hop).
+
+The reference's hop-by-hop EMesh model routes each packet XY
+dimension-ordered, one hop at a time, charging router + link delay plus a
+per-link queue-model contention delay at every hop, and occupying each
+traversed link for the packet's serialization time (reference:
+common/network/models/network_model_emesh_hop_by_hop.cc:146 routePacket,
+per-hop queue models in components/router/router_model.cc and
+[network/emesh_hop_by_hop] carbon_sim.cfg:299-313).
+
+TPU re-expression: all in-flight packets advance one hop per iteration of
+a bounded ``lax.while_loop``; each iteration is ONE exact segmented-FCFS
+sweep (engine/queue_models.fcfs) over the 4*T directed mesh links — all
+same-link packets of the batch serialize in arrival order against the
+link's carried horizon (``link_free``), exactly like the reference's
+per-link history queue model.  A packet's head advances router+link cycles
+per hop; each traversed link stays busy for the packet's flit count
+(wormhole serialization), and the tail's (flits-1)-cycle serialization is
+charged once at the destination, matching the zero-load hop-counter
+formula when links are idle.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from graphite_tpu.engine import queue_models
+from graphite_tpu.params import NetworkParams
+
+# Link direction codes (outgoing link of a tile).
+DIR_E, DIR_W, DIR_N, DIR_S = 0, 1, 2, 3
+NUM_DIRS = 4
+
+
+def make_link_free(num_tiles: int) -> jnp.ndarray:
+    """[NUM_DIRS, T] int64 per-directed-link busy horizons."""
+    return jnp.zeros((NUM_DIRS, num_tiles), dtype=jnp.int64)
+
+
+def _xy_step(pos: jnp.ndarray, dst: jnp.ndarray, mesh_width: int):
+    """One XY-dimension-ordered routing decision.
+
+    Returns (dir, next_pos, at_dst) for each packet (reference:
+    network_model_emesh_hop_by_hop.cc computeNextDest — X first, then Y).
+    """
+    sx, sy = pos % mesh_width, pos // mesh_width
+    tx, ty = dst % mesh_width, dst // mesh_width
+    go_e = sx < tx
+    go_w = sx > tx
+    go_y = ~go_e & ~go_w
+    go_n = go_y & (sy < ty)
+    d = jnp.where(go_e, DIR_E,
+                  jnp.where(go_w, DIR_W,
+                            jnp.where(go_n, DIR_N, DIR_S))).astype(jnp.int32)
+    delta = jnp.where(go_e, 1,
+                      jnp.where(go_w, -1,
+                                jnp.where(go_n, mesh_width, -mesh_width)))
+    return d, (pos + delta).astype(pos.dtype), pos == dst
+
+
+class FlightResult(NamedTuple):
+    arrival: jnp.ndarray    # [K] int64 — tail arrival at the destination
+    wait_ps: jnp.ndarray    # [K] int64 — total queueing delay en route
+    link_free: jnp.ndarray  # [NUM_DIRS, T] updated horizons
+
+
+def flight(net: NetworkParams, mesh_width: int, mesh_height: int,
+           src: jnp.ndarray, dst: jnp.ndarray, depart: jnp.ndarray,
+           flits, active: jnp.ndarray, link_free: jnp.ndarray,
+           period_ps: jnp.ndarray) -> FlightResult:
+    """Fly a batch of packets src->dst, contending on shared links.
+
+    src/dst: [K] int32 tiles; depart: [K] int64 ps; flits: scalar or [K];
+    active: [K] bool (inactive packets neither move nor occupy);
+    period_ps: [K] int32 ps per network cycle (sender's DVFS domain, used
+    for the whole path as in the zero-load model).
+    """
+    T = link_free.shape[1]
+    K = src.shape[0]
+    hop_cyc = net.router_delay_cycles + net.link_delay_cycles
+    max_hops = (mesh_width - 1) + (mesh_height - 1)
+    per = jnp.asarray(period_ps, jnp.int64)
+    fl = jnp.broadcast_to(jnp.asarray(flits, jnp.int64), (K,))
+    occ = fl * per                       # per-link serialization occupancy
+
+    def cond(c):
+        i, _pos, _t, infl, _lf, _w = c
+        return (i < max_hops) & infl.any()
+
+    def body(c):
+        i, pos, t, infl, lf, wait = c
+        d, npos, at = _xy_step(pos, dst, mesh_width)
+        fly = infl & ~at
+        link = (d * T + pos).astype(jnp.int32)
+        q = queue_models.fcfs(link, t, occ, fly, lf.reshape(-1))
+        t2 = jnp.where(fly, q.start + hop_cyc * per, t)
+        return (i + 1, jnp.where(fly, npos, pos), t2, fly,
+                q.free_at.reshape(NUM_DIRS, T),
+                wait + jnp.where(fly, q.delay, 0))
+
+    pos0 = jnp.asarray(src, jnp.int32)
+    t0 = jnp.where(active, depart, 0)
+    carry = (jnp.int32(0), pos0, t0, active & (pos0 != dst), link_free,
+             jnp.zeros(K, dtype=jnp.int64))
+    _, _, t, _, link_free, wait = jax.lax.while_loop(cond, body, carry)
+    arrival = jnp.where(active, t + jnp.maximum(fl - 1, 0) * per, 0)
+    return FlightResult(arrival=arrival, wait_ps=wait, link_free=link_free)

@@ -29,6 +29,7 @@ from graphite_tpu.engine import cache as cachemod
 from graphite_tpu.engine import dense
 from graphite_tpu.engine import directory as dirmod
 from graphite_tpu.engine import noc
+from graphite_tpu.engine import noc_flight
 from graphite_tpu.engine import queue_models
 from graphite_tpu.engine.core import _lat, _period, mcp_tile
 from graphite_tpu.engine.state import (
@@ -415,22 +416,61 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         oh_vown = _oh(vown_c, T)
         p_net_vown = _sel(oh_vown, p_net).astype(jnp.int32)
         p_l2_vown = _sel(oh_vown, p_l2).astype(jnp.int32)
-        evict_m_ps = noc.unicast_ps(
-            params.net_memory, home, vown_c, CTRL_BYTES,
-            p_net, params.mesh_width) \
-            + _lat(params.l2.access_cycles, p_l2_vown) \
-            + noc.unicast_ps(
-                params.net_memory, vown_c, home,
-                params.line_size + CTRL_BYTES,
-                p_net_vown, params.mesh_width)
+        l2_vown_ps = _lat(params.l2.access_cycles, p_l2_vown)
+
+        # ---- latency assembly (SURVEY.md 3.3's round trips).  Unicast
+        # legs are either zero-load closed forms (magic/emesh_hop_counter)
+        # or, under emesh_hop_by_hop, per-link FCFS-contended flights
+        # (engine/noc_flight.py) threading the link horizons through every
+        # leg in dependency order: request -> victim flush -> owner leg ->
+        # reply.  Invalidation multicasts stay zero-load (the reference's
+        # broadcast-tree option likewise bypasses per-hop unicast queues).
+        # Contention requires both the hop-by-hop model AND its queue model
+        # (reference: hop-by-hop with queue_model/enabled=false charges
+        # per-hop latency with no contention — identical to hop_counter).
+        contended = (params.net_memory.model == "emesh_hop_by_hop"
+                     and params.net_memory.queue_model_enabled)
+        link_wait = jnp.zeros(T, dtype=jnp.int64)
+        lf = state.link_free_mem
+        rows32 = rows.astype(jnp.int32)
+        if contended:
+            fr = noc_flight.flight(
+                params.net_memory, params.mesh_width, params.mesh_height,
+                rows32, home, issue, flits_req, win, lf, p_net)
+            lf = fr.link_free
+            link_wait = link_wait + fr.wait_ps
+            arrive = jnp.maximum(fr.arrival, line_floor)
+        else:
+            arrive = jnp.maximum(issue + net_req, line_floor)
+
+        ev_rt = evict_m | evict_o
+        if contended:
+            dep_ev = arrive + dir_ps
+            e1 = noc_flight.flight(
+                params.net_memory, params.mesh_width, params.mesh_height,
+                home, vown_c, dep_ev, flits_req, ev_rt, lf, p_net_home)
+            e2 = noc_flight.flight(
+                params.net_memory, params.mesh_width, params.mesh_height,
+                vown_c, home, e1.arrival + l2_vown_ps, flits_data, ev_rt,
+                e1.link_free, p_net_vown)
+            lf = e2.link_free
+            link_wait = link_wait + e1.wait_ps + e2.wait_ps
+            evict_m_ps = jnp.where(ev_rt, e2.arrival - dep_ev, 0)
+        else:
+            evict_m_ps = noc.unicast_ps(
+                params.net_memory, home, vown_c, CTRL_BYTES,
+                p_net_home, params.mesh_width) \
+                + l2_vown_ps \
+                + noc.unicast_ps(
+                    params.net_memory, vown_c, home,
+                    params.line_size + CTRL_BYTES,
+                    p_net_vown, params.mesh_width)
         evict_ps = jnp.where(evict_m, evict_m_ps, evict_ps)
         # O-state victim (MOSI): sharer-invalidation multicast AND the
         # owner's dirty-data flush leg — whichever completes later.
         evict_ps = jnp.where(evict_o, jnp.maximum(evict_ps, evict_m_ps),
                              evict_ps)
 
-        # ---- latency assembly (SURVEY.md 3.3's round trips, analytically)
-        arrive = jnp.maximum(issue + net_req, line_floor)
         # Replacement of a live victim entry completes before the new
         # request is served.
         t_dir = arrive + dir_ps + jnp.where(evicting, evict_ps, 0)
@@ -438,13 +478,27 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         oh_owner = _oh(owner, T)
         p_net_own = _sel(oh_owner, p_net).astype(jnp.int32)
         p_l2_own = _sel(oh_owner, p_l2).astype(jnp.int32)
-        leg_ps = noc.unicast_ps(params.net_memory, home, owner, CTRL_BYTES,
-                                p_net_home, params.mesh_width) \
-            + _lat(params.l2.access_cycles, p_l2_own) \
-            + noc.unicast_ps(params.net_memory, owner, home,
-                             params.line_size + CTRL_BYTES, p_net_own,
-                             params.mesh_width)
-        owner_ps = jnp.where(owner_leg, leg_ps, 0)
+        l2_own_ps = _lat(params.l2.access_cycles, p_l2_own)
+        if contended:
+            g1 = noc_flight.flight(
+                params.net_memory, params.mesh_width, params.mesh_height,
+                home, owner, t_dir, flits_req, owner_leg, lf, p_net_home)
+            g2 = noc_flight.flight(
+                params.net_memory, params.mesh_width, params.mesh_height,
+                owner, home, g1.arrival + l2_own_ps, flits_data, owner_leg,
+                g1.link_free, p_net_own)
+            lf = g2.link_free
+            link_wait = link_wait + g1.wait_ps + g2.wait_ps
+            owner_ps = jnp.where(owner_leg, g2.arrival - t_dir, 0)
+        else:
+            leg_ps = noc.unicast_ps(params.net_memory, home, owner,
+                                    CTRL_BYTES, p_net_home,
+                                    params.mesh_width) \
+                + l2_own_ps \
+                + noc.unicast_ps(params.net_memory, owner, home,
+                                 params.line_size + CTRL_BYTES, p_net_own,
+                                 params.mesh_width)
+            owner_ps = jnp.where(owner_leg, leg_ps, 0)
 
         need_read = win & act.dram_read
         dram_arrival = t_dir + owner_ps
@@ -464,12 +518,23 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         t_data = jnp.maximum(t_data, jnp.where(need_read, dram_ready, 0))
         t_data = jnp.maximum(t_data, t_dir + inv_ps)
 
+        if contended:
+            rr = noc_flight.flight(
+                params.net_memory, params.mesh_width, params.mesh_height,
+                home, rows32, t_data, flits_data, win, lf, p_net_home)
+            lf = rr.link_free
+            link_wait = link_wait + rr.wait_ps
+            reply_done = rr.arrival
+            state = state._replace(link_free_mem=lf)
+        else:
+            reply_done = t_data + reply_ps
+
         l2_fill_ps = _lat(params.l2.access_cycles, p_l2)
         l1_fill_ps = jnp.where(
             is_if, _lat(params.l1i.access_cycles,
                         _period(state, DVFSModule.L1_ICACHE)),
             _lat(params.l1d.access_cycles, p_l1))
-        completion = t_data + reply_ps + l2_fill_ps + l1_fill_ps \
+        completion = reply_done + l2_fill_ps + l1_fill_ps \
             + state.pend_extra
 
         # ---- apply directory entry updates: merged whole-row writes.
@@ -610,6 +675,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             + jnp.where(victim_dirty, flits_data, 0)
             + _binsum(oh_home, win, flits_data)
             + _binsum(oh_home, inv_count > 0, inv_count * flits_req),
+            net_link_wait_ps=c.net_link_wait_ps + link_wait,
             # Deferral events this round: way-slot collisions + fan-out
             # budget overflow + owner-delivery budget overflow (a request
             # deferred in N rounds counts N times; end-of-pass saturation

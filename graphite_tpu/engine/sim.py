@@ -113,6 +113,8 @@ class SimSummary:
         lines.append("[network (memory)]")
         row("Packets", agg["net_mem_pkts"])
         row("Flits", agg["net_mem_flits"])
+        row("Link Contention Delay (in ns, total)",
+            f"{ps_to_ns(agg['net_link_wait_ps']):.1f}")
         lines.append("[network (user)]")
         row("Packets", agg["net_user_pkts"])
         row("Flits", agg["net_user_flits"])

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.engine import noc_flight
 from graphite_tpu.events.schema import Trace
 from graphite_tpu.isa import DVFSModule, EventOp
 from graphite_tpu.params import SimParams
@@ -73,6 +74,8 @@ class Counters(NamedTuple):
     dram_writes: jnp.ndarray
     net_mem_pkts: jnp.ndarray        # memory-network packets this tile sent
     net_mem_flits: jnp.ndarray
+    net_link_wait_ps: jnp.ndarray    # per-link queueing delay this tile's
+    #   requests accumulated en route (emesh_hop_by_hop contention only)
     net_user_pkts: jnp.ndarray
     net_user_flits: jnp.ndarray
     sends: jnp.ndarray
@@ -201,6 +204,10 @@ class SimState(NamedTuple):
     # -- memory controllers (reference: dram_cntlr.h + dram_perf_model.h)
     dram_free_at: jnp.ndarray  # [T] int64 — FCFS queue-model horizon
 
+    # -- mesh link horizons (emesh_hop_by_hop contention; reference:
+    # per-link queue models in network_model_emesh_hop_by_hop.cc)
+    link_free_mem: jnp.ndarray  # [NUM_DIRS, T] int64 directed-link horizons
+
     # -- sync objects, global (reference: sync_server.h SimMutex/SimBarrier)
     lock_holder: jnp.ndarray   # [NL] int32 holder tile + 1, 0 = free
     lock_free_at: jnp.ndarray  # [NL] int64 time the lock was/will be released
@@ -266,6 +273,7 @@ def make_state(params: SimParams,
         lq_next=jnp.zeros(T, dtype=jnp.int32),
         sq_next=jnp.zeros(T, dtype=jnp.int32),
         dram_free_at=jnp.zeros(T, dtype=jnp.int64),
+        link_free_mem=noc_flight.make_link_free(T),
         lock_holder=jnp.zeros(max_mutexes, dtype=jnp.int32),
         lock_free_at=jnp.zeros(max_mutexes, dtype=jnp.int64),
         bar_count=jnp.zeros(max_barriers, dtype=jnp.int32),

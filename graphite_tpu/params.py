@@ -389,7 +389,7 @@ class SimParams:
         _check("network/user model", self.net_user.model,
                {"magic", "emesh_hop_counter"})
         _check("network/memory model", self.net_memory.model,
-               {"magic", "emesh_hop_counter"})
+               {"magic", "emesh_hop_counter", "emesh_hop_by_hop"})
         _check("branch_predictor/type", self.core.bp_type,
                {"one_bit", "none"})
 

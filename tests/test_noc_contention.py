@@ -1,0 +1,118 @@
+"""emesh_hop_by_hop link contention tests.
+
+Pin the contended-mesh contract (reference:
+network_model_emesh_hop_by_hop.cc:146 + per-link queue models): same-link
+packets serialize in FCFS order against carried link horizons; an idle
+mesh reproduces the zero-load hop-counter latency exactly.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine import noc, noc_flight
+from graphite_tpu.engine.sim import run_simulation
+from graphite_tpu.events.schema import TraceBuilder
+from graphite_tpu.events import synth
+from graphite_tpu.params import NetworkParams, SimParams
+
+NET = NetworkParams(model="emesh_hop_by_hop", flit_width_bits=64,
+                    router_delay_cycles=1, link_delay_cycles=1,
+                    queue_model_enabled=True, queue_model_type="history_tree",
+                    broadcast_tree_enabled=False)
+
+
+def make_params(tiles=16, **over):
+    cfg = load_config()
+    cfg.set("general/total_cores", tiles)
+    cfg.set("network/memory", "emesh_hop_by_hop")
+    for k, v in over.items():
+        cfg.set(k, v)
+    return SimParams.from_config(cfg)
+
+
+def _fly(src, dst, depart, flits, mesh_w, mesh_h, T, link_free=None,
+         active=None):
+    src = jnp.asarray(src, jnp.int32)
+    K = src.shape[0]
+    if link_free is None:
+        link_free = noc_flight.make_link_free(T)
+    if active is None:
+        active = jnp.ones(K, dtype=bool)
+    return noc_flight.flight(
+        NET, mesh_w, mesh_h, src, jnp.asarray(dst, jnp.int32),
+        jnp.asarray(depart, jnp.int64), flits, active, link_free,
+        jnp.full(K, 500, jnp.int32))   # 2 GHz -> 500 ps/cycle
+
+
+def test_idle_mesh_matches_zero_load():
+    """A single packet on an idle mesh pays exactly the hop-counter
+    latency: hops*(router+link) + (flits-1), in network cycles."""
+    # 4x4 mesh: tile 0 -> tile 15 is 3+3 = 6 hops.
+    r = _fly([0], [15], [0], 5, 4, 4, 16)
+    zero_load = noc.unicast_ps(
+        NET, jnp.asarray([0]), jnp.asarray([15]),
+        (5 * 64) // 8 - noc.PACKET_HEADER_BYTES,   # payload giving 5 flits
+        jnp.asarray([500], jnp.int32), 4)
+    assert int(r.arrival[0]) == 6 * 2 * 500 + 4 * 500
+    assert int(r.arrival[0]) == int(zero_load[0])
+    assert int(r.wait_ps[0]) == 0
+
+
+def test_hotspot_serializes_fcfs():
+    """Hand-computed case: three 1-flit packets from tile 1 region all
+    crossing the SAME last link (tile 1 -> tile 0) serialize by arrival.
+
+    2x2 mesh, packets from tile 1 to tile 0 departing at t=0, 0, 0:
+    link (W, tile 1) serves them one flit apart; hop latency 2 cycles.
+    Arrivals: 2c, 3c, 4c (c = 500 ps cycle).
+    """
+    r = _fly([1, 1, 1], [0, 0, 0], [0, 0, 0], 1, 2, 2, 4)
+    arr = sorted(int(a) for a in np.asarray(r.arrival))
+    c = 500
+    assert arr == [2 * c, 3 * c, 4 * c]
+    # exactly 0 + 1 + 2 flit-times of queueing were accumulated
+    assert int(np.asarray(r.wait_ps).sum()) == (0 + 1 + 2) * c
+
+
+def test_carried_horizon_backpressures_next_batch():
+    """Link horizons persist: a second batch arriving while the link is
+    still busy from batch one waits for it (the queue model's memory)."""
+    r1 = _fly([1], [0], [0], 8, 2, 2, 4)            # 8-flit occupancy
+    r2 = _fly([1], [0], [0], 8, 2, 2, 4, link_free=r1.link_free)
+    assert int(r2.wait_ps[0]) == 8 * 500            # waits out batch 1
+    assert int(r2.arrival[0]) == int(r1.arrival[0]) + 8 * 500
+
+
+def test_distinct_links_no_interference():
+    """Packets on disjoint paths never wait for each other."""
+    #  4x4 mesh: 0->1 (E link of 0) and 5->6 (E link of 5)
+    r = _fly([0, 5], [1, 6], [0, 0], 4, 4, 4, 16)
+    assert int(np.asarray(r.wait_ps).sum()) == 0
+
+
+def test_e2e_contended_slower_than_zero_load():
+    """BASELINE config-5 shape: all tiles hammer lines homed at one tile;
+    the contended model must charge visibly more time than hop-counter."""
+    tiles = 16
+    tb_args = dict(lines=12, passes=2)
+    trace = synth.gen_shared_readers(tiles, **tb_args)
+    p_cont = make_params(tiles)
+    p_zero = make_params(tiles, **{"network/memory": "emesh_hop_counter"})
+    s_cont = run_simulation(p_cont, trace)
+    s_zero = run_simulation(p_zero, trace)
+    wait = int(s_cont.counters["net_link_wait_ps"].sum())
+    assert wait > 0
+    assert s_cont.completion_time_ps > s_zero.completion_time_ps
+    # zero-load run records no link contention
+    assert int(s_zero.counters["net_link_wait_ps"].sum()) == 0
+
+
+def test_contended_run_deterministic():
+    params = make_params(8)
+    trace = synth.gen_migratory(8, lines=4, rounds=2)
+    s1 = run_simulation(params, trace)
+    s2 = run_simulation(params, trace)
+    assert s1.completion_time_ps == s2.completion_time_ps
+    assert int(s1.counters["net_link_wait_ps"].sum()) \
+        == int(s2.counters["net_link_wait_ps"].sum())
