@@ -38,7 +38,7 @@ from graphite_tpu.events.schema import ICACHE_BYTES_PER_INSTRUCTION
 from graphite_tpu.isa import DVFSModule, EventOp
 from graphite_tpu.params import SimParams
 
-I, S, M = cachemod.I, cachemod.S, cachemod.M
+I, S, E, M = cachemod.I, cachemod.S, cachemod.E, cachemod.M
 
 
 def _lat(cycles, period_ps):
@@ -120,10 +120,14 @@ def local_advance(params: SimParams, state: SimState,
         l2_tag_ps = _lat(params.l2.tags_access_cycles, p_l2)
         cycle_ps = _lat(1, p_core)
 
+        shared_l2 = params.shared_l2
         line = addr >> line_bits
         pI = cachemod.probe(st.l1i, line, params.l1i.num_sets)
         pD = cachemod.probe(st.l1d, line, params.l1d.num_sets)
-        pL2 = cachemod.probe(st.l2, line, params.l2.num_sets)
+        if shared_l2:
+            pL2 = None   # no private L2: L1 misses go to the home slice
+        else:
+            pL2 = cachemod.probe(st.l2, line, params.l2.num_sets)
 
         # ---------------------------------------------------- COMPUTE blocks
         is_comp = op == EventOp.COMPUTE
@@ -137,10 +141,16 @@ def local_advance(params: SimParams, state: SimState,
         # latency is charged for each line of the block (sequential-stream
         # approximation — only the first line's tags are actually filled).
         fetch_ps = icount_ev * l1i_ps
-        comp_l2path = is_comp & ~pI.hit & pL2.hit
-        comp_block = is_comp & ~pI.hit & ~pL2.hit
+        if shared_l2:
+            comp_l2path = jnp.zeros_like(is_comp)
+            comp_block = is_comp & ~pI.hit
+            dt_comp = cost_ps + fetch_ps
+        else:
+            comp_l2path = is_comp & ~pI.hit & pL2.hit
+            comp_block = is_comp & ~pI.hit & ~pL2.hit
+            dt_comp = cost_ps + fetch_ps \
+                + jnp.where(~pI.hit, n_lines * l2_ps, 0)
         comp_ok = is_comp & ~comp_block
-        dt_comp = cost_ps + fetch_ps + jnp.where(~pI.hit, n_lines * l2_ps, 0)
 
         # ------------------------------------------------------- BRANCH
         is_br = op == EventOp.BRANCH
@@ -167,11 +177,21 @@ def local_advance(params: SimParams, state: SimState,
         is_at = op == EventOp.ATOMIC
         is_wr = (op == EventOp.MEM_WRITE) | is_at
         is_mem = is_rd | is_wr
-        l1_ok = pD.hit & (is_rd | (pD.state == M))
-        l2_ok = pL2.hit & (is_rd | (pL2.state == M))
+        # Writable states: M only — except shared-L2 MESI, where an
+        # E-granted L1 line is silently writable (the exclusive owner
+        # upgrades E->M locally without telling the home slice; reference
+        # pr_l1_sh_l2_mesi l1_cache_cntlr store-on-E path).
+        mesi_local = params.protocol_kind == "sh_l2_mesi"
+        writable = pD.state >= (E if mesi_local else M)
+        l1_ok = pD.hit & (is_rd | writable)
         mem_l1 = is_mem & l1_ok
-        mem_l2 = is_mem & ~l1_ok & l2_ok
-        mem_rem = is_mem & ~l1_ok & ~l2_ok
+        if shared_l2:
+            mem_l2 = jnp.zeros_like(mem_l1)
+            mem_rem = is_mem & ~l1_ok
+        else:
+            l2_ok = pL2.hit & (is_rd | (pL2.state == M))
+            mem_l2 = is_mem & ~l1_ok & l2_ok
+            mem_rem = is_mem & ~l1_ok & ~l2_ok
         at_extra = jnp.where(is_at, cycle_ps, 0)
         dt_mem_l1 = l1d_ps + at_extra
         dt_mem_l2 = l1d_ps + l2_ps + at_extra
@@ -279,9 +299,13 @@ def local_advance(params: SimParams, state: SimState,
         pend_addr = jnp.where(is_bar | is_lock, jnp.int64(arg),
                               jnp.where(send_block, jnp.int64(jnp.maximum(arg, 0)),
                                         jnp.where(blocked, addr, st.pend_addr)))
+        # Request-issue point: after the local tag checks that discovered
+        # the miss (L1 only under shared L2 — there is no private L2 tag
+        # array to consult before going to the home slice).
+        miss_tags_ps = cycle_ps if shared_l2 else l2_tag_ps
         issue = clk + jnp.where(
-            comp_block, l1i_ps + l2_tag_ps,
-            jnp.where(mem_rem, l1d_ps + l2_tag_ps, cycle_ps))
+            comp_block, l1i_ps + miss_tags_ps,
+            jnp.where(mem_rem, l1d_ps + miss_tags_ps, cycle_ps))
         pend_issue = jnp.where(blocked, issue, st.pend_issue)
         # For memory requests pend_aux carries the atomic flag (resolve
         # needs it: iocoom lets plain loads/stores complete out-of-order
@@ -292,29 +316,42 @@ def local_advance(params: SimParams, state: SimState,
                              st.pend_aux)
         # Local cost still owed once the remote part resolves: a blocked
         # COMPUTE block's execution + fetch time (minus the remotely
-        # fetched first line, which resolve prices), an atomic's RMW cycle.
+        # fetched first line, which resolve prices; under shared L2 the
+        # later lines' fetch rides the same slice round trip), an atomic's
+        # RMW cycle.
         extra = jnp.where(
-            comp_block, cost_ps + fetch_ps + (n_lines - 1) * l2_ps,
+            comp_block,
+            cost_ps + fetch_ps
+            + (0 if shared_l2 else (n_lines - 1) * l2_ps),
             jnp.where(mem_rem, at_extra, 0))
         pend_extra = jnp.where(blocked, extra, st.pend_extra)
 
         # ------------------------------------------------- cache updates
         l1i = cachemod.touch(st.l1i, pI.set_idx, pI.way, is_comp & pI.hit)
-        fI = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
-                           comp_l2path, params.l1i.num_sets,
-                           params.l1i.replacement)
-        l1i = fI.cache
-        l2 = cachemod.touch(st.l2, pL2.set_idx, pL2.way,
-                            (comp_l2path | mem_l2))
+        if shared_l2:
+            l2 = st.l2
+            l1d = cachemod.touch(st.l1d, pD.set_idx, pD.way, mem_l1)
+            if mesi_local:
+                # Silent E->M upgrade on a store hit to an E-granted line.
+                l1d = cachemod.set_state(
+                    l1d, pD.set_idx, pD.way, jnp.full(T, M, jnp.int32),
+                    mem_l1 & is_wr & (pD.state == E))
+        else:
+            fI = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
+                               comp_l2path, params.l1i.num_sets,
+                               params.l1i.replacement)
+            l1i = fI.cache
+            l2 = cachemod.touch(st.l2, pL2.set_idx, pL2.way,
+                                (comp_l2path | mem_l2))
 
-        l1d = cachemod.touch(st.l1d, pD.set_idx, pD.way, mem_l1)
-        # L1D fill from a local L2 hit; dirty L1 victims fold into the
-        # (inclusive) L2 copy, which already holds M state — timing-only.
-        fD = cachemod.fill(l1d, line,
-                           jnp.where(is_wr, M, S).astype(jnp.int32),
-                           mem_l2, params.l1d.num_sets,
-                           params.l1d.replacement)
-        l1d = fD.cache
+            l1d = cachemod.touch(st.l1d, pD.set_idx, pD.way, mem_l1)
+            # L1D fill from a local L2 hit; dirty L1 victims fold into the
+            # (inclusive) L2 copy, which already holds M state — timing-only.
+            fD = cachemod.fill(l1d, line,
+                               jnp.where(is_wr, M, S).astype(jnp.int32),
+                               mem_l2, params.l1d.num_sets,
+                               params.l1d.replacement)
+            l1d = fD.cache
 
         # ------------------------------------------------------- counters
         def add(x, mask, val=1):
@@ -332,9 +369,12 @@ def local_advance(params: SimParams, state: SimState,
             l1d_read_miss=add(c.l1d_read_miss, is_rd & ~l1_ok),
             l1d_write=add(c.l1d_write, is_wr),
             l1d_write_miss=add(c.l1d_write_miss, is_wr & ~l1_ok),
-            l2_access=add(c.l2_access, mem_l2 | mem_rem | comp_l2path
-                          | comp_block),
-            l2_miss=add(c.l2_miss, mem_rem | comp_block),
+            # Under shared L2 the slice accesses are counted at the home
+            # tile by the resolve phase, not locally.
+            l2_access=c.l2_access if shared_l2 else add(
+                c.l2_access, mem_l2 | mem_rem | comp_l2path | comp_block),
+            l2_miss=c.l2_miss if shared_l2 else add(
+                c.l2_miss, mem_rem | comp_block),
             branches=add(c.branches, is_br),
             mispredicts=add(c.mispredicts, is_br & ~correct),
             net_user_pkts=add(c.net_user_pkts, is_send),

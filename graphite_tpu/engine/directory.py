@@ -29,7 +29,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from graphite_tpu.engine.cache import I, M, O, S
+from graphite_tpu.engine.cache import E, I, M, O, S
 
 # ------------------------------------------------------------- bitmaps
 
@@ -87,6 +87,9 @@ def transition(protocol_kind: str, is_ex: jnp.ndarray, requester: jnp.ndarray,
     if protocol_kind == "mosi":
         return mosi_transition(is_ex, requester, state, owner, sharers,
                                num_words)
+    if protocol_kind in ("sh_l2_msi", "sh_l2_mesi"):
+        return sh_l2_transition(protocol_kind == "sh_l2_mesi", is_ex,
+                                requester, state, owner, sharers, num_words)
     return msi_transition(is_ex, requester, state, owner, sharers, num_words)
 
 
@@ -202,6 +205,75 @@ def mosi_transition(is_ex: jnp.ndarray, requester: jnp.ndarray,
     owner_downgrade = jnp.where(is_ex, I, O).astype(jnp.int32)
     dram_read = ~has_owner & ~req_is_owner
     dram_write = jnp.zeros_like(owner_leg)   # O defers writeback to eviction
+    return MsiActions(
+        new_state=new_state.astype(jnp.int32),
+        new_owner=new_owner.astype(jnp.int32),
+        new_sharers=new_sharers,
+        owner_leg=owner_leg,
+        owner_tile=jnp.maximum(owner, 0).astype(jnp.int32),
+        owner_downgrade_to=owner_downgrade,
+        inv_targets=inv_targets,
+        dram_read=dram_read,
+        dram_write=dram_write,
+    )
+
+
+def sh_l2_transition(mesi: bool, is_ex: jnp.ndarray, requester: jnp.ndarray,
+                     state: jnp.ndarray, owner: jnp.ndarray,
+                     sharers: jnp.ndarray, num_words: int) -> MsiActions:
+    """The shared-distributed-L2 slice FSM (reference:
+    pr_l1_sh_l2_msi/l2_cache_cntlr.cc + dram_directory integrated in L2;
+    MESI variant pr_l1_sh_l2_mesi/).
+
+    The entry IS the slice line; its state tracks the L1 copies:
+      I — not in the slice (a slice MISS: the only case touching DRAM)
+      S — clean in slice; zero or more L1 sharers
+      O — DIRTY in slice (an L1 owner wrote and flushed back), no L1 owner
+      E — clean in slice, one exclusive L1 owner (MESI first-reader grant;
+          the owner may silently upgrade its L1 copy E->M)
+      M — slice line owned dirty by one L1
+    Data always comes from the slice (or the L1 owner) on a hit; DRAM is
+    read only to fill a slice miss, written only on dirty slice eviction.
+    """
+    req_bit = make_tile_bit(requester, num_words)
+    own_bit = make_tile_bit(jnp.maximum(owner, 0), num_words)
+    has_live_owner = ((state == M) | (state == E)) & (owner >= 0)
+    has_owner = has_live_owner & (owner != requester)
+
+    miss = state == I
+
+    # --- SH_REQ outcomes
+    # Slice miss: MESI grants E to a sole first reader; MSI grants S.
+    # A downgraded E owner may have silently upgraded E->M in its L1, so
+    # its flushed-back data is conservatively treated as dirty (entry ->
+    # O, like M): the slice can't know, and assuming clean would skip the
+    # DRAM writeback the reference performs when the owner HAD written.
+    sh_miss_state = jnp.full_like(state, E if mesi else S)
+    sh_state = jnp.where(miss, sh_miss_state,
+                         jnp.where((state == M) | (state == E), O, state))
+    sh_owner = jnp.where(miss & mesi, requester.astype(jnp.int32), -1)
+    sh_sharers = jnp.where(
+        ((state == M) | (state == E))[:, None],
+        own_bit | req_bit, sharers | req_bit)
+
+    # --- EX_REQ outcomes
+    ex_state = jnp.full_like(state, M)
+    ex_owner = requester.astype(jnp.int32)
+    ex_sharers = req_bit
+    inv_targets = jnp.where(
+        (is_ex & ((state == S) | (state == O)))[:, None],
+        sharers & ~req_bit, jnp.zeros_like(sharers))
+
+    new_state = jnp.where(is_ex, ex_state, sh_state)
+    new_owner = jnp.where(is_ex, ex_owner, sh_owner)
+    new_sharers = jnp.where(is_ex[:, None], ex_sharers, sh_sharers)
+
+    owner_leg = has_owner
+    owner_downgrade = jnp.where(is_ex, I, S).astype(jnp.int32)
+    dram_read = miss
+    # Dirty data lives in the slice; DRAM is written only on slice
+    # eviction, never on a transition.
+    dram_write = jnp.zeros_like(owner_leg)
     return MsiActions(
         new_state=new_state.astype(jnp.int32),
         new_owner=new_owner.astype(jnp.int32),

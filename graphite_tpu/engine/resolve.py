@@ -39,7 +39,8 @@ from graphite_tpu.engine.state import (
 from graphite_tpu.isa import DVFSModule
 from graphite_tpu.params import SimParams
 
-I, S, O, M = cachemod.I, cachemod.S, cachemod.O, cachemod.M
+I, S, O, E, M = (cachemod.I, cachemod.S, cachemod.O, cachemod.E,
+                 cachemod.M)
 
 # Control-message payload bytes (request/inv/ack packets; reference
 # ShmemMsg header, shmem_msg.h:12-29).
@@ -52,25 +53,43 @@ J_OWN = 8
 
 
 def home_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
-    """Home memory-controller tile for a line: interleave lines across the
-    controllers, controllers spread over the mesh with a fixed stride
-    (reference: address_home_lookup.cc + [dram] controller placement)."""
+    """Home tile serving a line's coherence requests.
+
+    Private-L2 protocols: the memory-controller/directory tile — lines
+    interleave across the controllers, controllers spread over the mesh
+    with a fixed stride (reference: address_home_lookup.cc + [dram]
+    controller placement).  Shared-L2 protocols: every tile hosts an L2
+    slice, lines interleave across all of them (reference:
+    pr_l1_sh_l2_msi/l2_cache_hash_fn.cc)."""
+    if params.shared_l2:
+        return (line % params.num_tiles).astype(jnp.int32)
+    n = params.dram.num_controllers
+    return ((line % n) * params.dram.controller_home_stride).astype(jnp.int32)
+
+
+def dram_site_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
+    """Memory-controller tile for a line (== home_of_line for private-L2
+    protocols; under shared L2 the slice home and the DRAM controller can
+    be different tiles, adding a slice->controller leg)."""
     n = params.dram.num_controllers
     return ((line % n) * params.dram.controller_home_stride).astype(jnp.int32)
 
 
 def dir_set_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
-    """Directory set within a home slice, XOR-folding the high line bits.
+    """Directory/slice set within a home tile, XOR-folding the high line
+    bits.
 
-    A plain ``(line // nctl) % ndsets`` aliases power-of-two-strided
-    allocations (e.g. per-tile buffers spaced nctl*ndsets lines apart) into
-    the same set and thrashes an otherwise nearly-empty directory; folding
-    the bits above the set index breaks such strides.  (The reference's
-    directory cache hashes the address into its sets the same
+    A plain ``(line // nslices) % ndsets`` aliases power-of-two-strided
+    allocations (e.g. per-tile buffers spaced nslices*ndsets lines apart)
+    into the same set and thrashes an otherwise nearly-empty directory;
+    folding the bits above the set index breaks such strides.  (The
+    reference's directory cache hashes the address into its sets the same
     way generic caches do — directory_cache.cc getSetIndex.)
     """
     ndsets = params.directory.num_sets
-    x = line // params.dram.num_controllers
+    nslices = params.num_tiles if params.shared_l2 \
+        else params.dram.num_controllers
+    x = line // nslices
     bits = ndsets.bit_length() - 1
     x = x ^ (x >> bits) ^ (x >> (2 * bits)) ^ (x >> (3 * bits))
     return (x % ndsets).astype(jnp.int32)
@@ -178,9 +197,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     # that share nothing.
     hidx = (dense.fmix64(line) % jnp.uint64(H)).astype(jnp.int32)
 
-    # Per-tile clock periods.
+    # Per-tile clock periods.  (Shared L2: the "directory" access is the
+    # slice's cache access, clocked by the L2 domain.)
     p_net = _period(state, DVFSModule.NETWORK_MEMORY)
-    p_dir = _period(state, DVFSModule.DIRECTORY)
+    p_dir = _period(state, DVFSModule.L2_CACHE if params.shared_l2
+                    else DVFSModule.DIRECTORY)
     p_l2 = _period(state, DVFSModule.L2_CACHE)
     p_l1 = _period(state, DVFSModule.L1_DCACHE)
     p_core = _period(state, DVFSModule.CORE)
@@ -305,7 +326,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         vowner = jnp.take_along_axis(downer, way[:, None], axis=1)[:, 0]
         vsharers = jnp.take_along_axis(
             dsharers, way[:, None, None], axis=1)[:, 0, :]
-        evict_m = evicting & (vstate == M) & (vowner >= 0)
+        # Owner-flush victims: M always; E too under shared-L2 MESI (the
+        # exclusive owner may have silently upgraded, so its flush is
+        # conservatively priced and written back like a dirty one).
+        if params.protocol_kind == "sh_l2_mesi":
+            evict_m = evicting & ((vstate == M) | (vstate == E)) \
+                & (vowner >= 0)
+        else:
+            evict_m = evicting & (vstate == M) & (vowner >= 0)
         # Empty-S entries (every sharer already dropped the line silently)
         # need no invalidation traffic — don't burn a fan-out slot on them.
         # O-state victims (MOSI) carry their owner in the sharer bitmap, so
@@ -356,7 +384,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         evict_m = evict_m1 & ~ow_defer
         evict_s = evict_s & ~fan_defer & ~ow_defer
         evicting = evicting & ~fan_defer & ~ow_defer
-        evict_o = evicting & (vstate == O) & (vowner >= 0)
+        evict_o = evicting & (vstate == O)
         owner_leg = owner_leg1 & ~ow_defer
         val2 = jnp.concatenate([owner_leg, evict_m])
         oh_t2 = oh_t2 & val2[:, None]
@@ -443,7 +471,12 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         else:
             arrive = jnp.maximum(issue + net_req, line_floor)
 
-        ev_rt = evict_m | evict_o
+        # Victim flush round trips: M victims always (L1/L2 owner flush);
+        # O victims only under MOSI (the private owner holds the dirty
+        # data) — under shared L2 the slice itself holds O data, so its
+        # eviction writes DRAM without visiting any other tile.
+        ev_rt = (evict_m | evict_o) if params.protocol_kind == "mosi" \
+            else evict_m
         if contended:
             dep_ev = arrive + dir_ps
             e1 = noc_flight.flight(
@@ -466,10 +499,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                     params.line_size + CTRL_BYTES,
                     p_net_vown, params.mesh_width)
         evict_ps = jnp.where(evict_m, evict_m_ps, evict_ps)
-        # O-state victim (MOSI): sharer-invalidation multicast AND the
-        # owner's dirty-data flush leg — whichever completes later.
-        evict_ps = jnp.where(evict_o, jnp.maximum(evict_ps, evict_m_ps),
-                             evict_ps)
+        if params.protocol_kind == "mosi":
+            # O-state victim: sharer-invalidation multicast AND the
+            # owner's dirty-data flush leg — whichever completes later.
+            evict_ps = jnp.where(evict_o, jnp.maximum(evict_ps, evict_m_ps),
+                                 evict_ps)
 
         # Replacement of a live victim entry completes before the new
         # request is served.
@@ -501,18 +535,39 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             owner_ps = jnp.where(owner_leg, leg_ps, 0)
 
         need_read = win & act.dram_read
-        dram_arrival = t_dir + owner_ps
-        q = queue_models.fcfs(home, dram_arrival,
+        if params.shared_l2:
+            # The slice home and the memory controller can differ: a slice
+            # miss adds slice->controller request + data-return legs
+            # (zero-load; reference pr_l1_sh_l2 dram_cntlr placement).
+            dsite = dram_site_of_line(params, line)
+            oh_dsite = _oh(dsite, T)
+            local_ctl = home == dsite
+            to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
+                params.net_memory, home, dsite, CTRL_BYTES, p_net_home,
+                params.mesh_width))
+            from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
+                params.net_memory, dsite, home,
+                params.line_size + CTRL_BYTES,
+                _sel(oh_dsite, p_net).astype(jnp.int32),
+                params.mesh_width))
+        else:
+            dsite = home
+            oh_dsite = oh_home
+            to_dram_ps = from_dram_ps = jnp.int64(0)
+        dram_arrival = t_dir + owner_ps + to_dram_ps
+        q = queue_models.fcfs(dsite, dram_arrival,
                               jnp.full(T, dram_service_ps), need_read,
                               state.dram_free_at)
-        dram_ready = q.start + dram_access_ps + dram_service_ps
+        dram_ready = q.start + dram_access_ps + dram_service_ps \
+            + from_dram_ps
         # Writebacks (owner-leg flushes that reach DRAM, dirty victim
         # evictions) occupy the controller off the critical path (write
-        # buffer): occupancy only.  MOSI owner forwards skip DRAM entirely
-        # (act.dram_write False); O-victim flushes do land there.
+        # buffer): occupancy only.  MOSI owner forwards and shared-L2
+        # transitions skip DRAM entirely (act.dram_write False); dirty
+        # victim evictions (M flushes, O slice lines) do land there.
         dram_wb = (act.dram_write & win) | evict_m | evict_o
         state = state._replace(dram_free_at=q.free_at + _binsum(
-            oh_home, dram_wb, dram_service_ps))
+            oh_dsite, dram_wb, dram_service_ps))
 
         t_data = t_dir + owner_ps
         t_data = jnp.maximum(t_data, jnp.where(need_read, dram_ready, 0))
@@ -529,13 +584,17 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         else:
             reply_done = t_data + reply_ps
 
-        l2_fill_ps = _lat(params.l2.access_cycles, p_l2)
         l1_fill_ps = jnp.where(
             is_if, _lat(params.l1i.access_cycles,
                         _period(state, DVFSModule.L1_ICACHE)),
             _lat(params.l1d.access_cycles, p_l1))
-        completion = reply_done + l2_fill_ps + l1_fill_ps \
-            + state.pend_extra
+        if params.shared_l2:
+            # No private L2 to fill through on the requester side.
+            completion = reply_done + l1_fill_ps + state.pend_extra
+        else:
+            l2_fill_ps = _lat(params.l2.access_cycles, p_l2)
+            completion = reply_done + l2_fill_ps + l1_fill_ps \
+                + state.pend_extra
 
         # ---- apply directory entry updates: merged whole-row writes.
         # Several same-set winners per round are the common case (distinct
@@ -610,40 +669,72 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             l1d=cachemod.invalidate_by_value(
                 state.l1d, dlv_lines, dlv_valid, dlv_tgt))
 
-        # ---- requester-side fills (L2 always; L1D or L1I by request kind)
-        f2 = cachemod.fill(state.l2, line,
-                           jnp.where(is_ex, M, S).astype(jnp.int32),
-                           win, params.l2.num_sets, params.l2.replacement)
-        state = state._replace(l2=f2.cache)
-        victim_dirty = win & ((f2.victim_state == M)
-                              | (f2.victim_state == O))
-        victim_live = win & (f2.victim_state != I)
-        victim_home = home_of_line(params, f2.victim_tag)
-        oh_vhome = _oh(victim_home, T)
-        state = state._replace(dram_free_at=state.dram_free_at + _binsum(
-            oh_vhome, victim_dirty, dram_service_ps))
-        # An evicted-from-L2 line also leaves L1 (inclusive hierarchy,
-        # reference l2_cache_cntlr invalidation of L1 on eviction).
-        state = state._replace(l1d=cachemod.invalidate_by_value(
-            state.l1d, f2.victim_tag[:, None], victim_live[:, None],
-            jnp.full((T, 1), I, dtype=jnp.int32)))
-        # Notify the victim line's home directory (reference sends eviction
-        # writebacks that downgrade the entry; silently dropping them left
-        # stale owners/sharer bits that charge phantom coherence legs).
-        # Off the requester's critical path.
-        state = _dir_evict_notify(params, state, rows, f2.victim_tag,
-                                  f2.victim_state, victim_live)
+        # ---- requester-side fills (private L2 then L1, or L1-only under
+        # shared L2; L1D or L1I by request kind)
+        if params.shared_l2:
+            # MESI first-reader grant: fill the L1 line in E so a later
+            # local store silently upgrades it (core.py mesi_local path).
+            granted_e = win & ~is_ex & (act.new_state == E)
+            l1_state = jnp.where(is_ex, M,
+                                 jnp.where(granted_e, E, S)).astype(
+                                     jnp.int32)
+            fd = cachemod.fill(state.l1d, line, l1_state, win & ~is_if,
+                               params.l1d.num_sets, params.l1d.replacement)
+            state = state._replace(l1d=fd.cache)
+            # L1 victims report back to their slice: dirty ones flush data
+            # into the slice (entry -> O), clean drops clear sharer bits.
+            # The dirty flush is a line-size WB data packet on the memory
+            # network (counted below via victim_dirty; off the critical
+            # path, so no latency/link-contention charge) — it lands in
+            # the slice, not DRAM.
+            victim_dirty = win & ~is_if & (fd.victim_state == M)
+            oh_vhome = _oh(home_of_line(params, fd.victim_tag), T)
+            state = _sh_l1_evict_notify(
+                params, state, rows, fd.victim_tag, fd.victim_state,
+                win & ~is_if & (fd.victim_state != I))
+            fi = cachemod.fill(state.l1i, line,
+                               jnp.full(T, S, dtype=jnp.int32),
+                               win & is_if, params.l1i.num_sets,
+                               params.l1i.replacement)
+            state = state._replace(l1i=fi.cache)
+            state = _sh_l1_evict_notify(
+                params, state, rows, fi.victim_tag, fi.victim_state,
+                win & is_if & (fi.victim_state != I))
+        else:
+            f2 = cachemod.fill(state.l2, line,
+                               jnp.where(is_ex, M, S).astype(jnp.int32),
+                               win, params.l2.num_sets,
+                               params.l2.replacement)
+            state = state._replace(l2=f2.cache)
+            victim_dirty = win & ((f2.victim_state == M)
+                                  | (f2.victim_state == O))
+            victim_live = win & (f2.victim_state != I)
+            victim_home = dram_site_of_line(params, f2.victim_tag)
+            oh_vhome = _oh(victim_home, T)
+            state = state._replace(dram_free_at=state.dram_free_at + _binsum(
+                oh_vhome, victim_dirty, dram_service_ps))
+            # An evicted-from-L2 line also leaves L1 (inclusive hierarchy,
+            # reference l2_cache_cntlr invalidation of L1 on eviction).
+            state = state._replace(l1d=cachemod.invalidate_by_value(
+                state.l1d, f2.victim_tag[:, None], victim_live[:, None],
+                jnp.full((T, 1), I, dtype=jnp.int32)))
+            # Notify the victim line's home directory (reference sends
+            # eviction writebacks that downgrade the entry; silently
+            # dropping them left stale owners/sharer bits that charge
+            # phantom coherence legs).  Off the requester's critical path.
+            state = _dir_evict_notify(params, state, rows, f2.victim_tag,
+                                      f2.victim_state, victim_live)
 
-        fd = cachemod.fill(state.l1d, line,
-                           jnp.where(is_ex, M, S).astype(jnp.int32),
-                           win & ~is_if, params.l1d.num_sets,
-                           params.l1d.replacement)
-        state = state._replace(l1d=fd.cache)
-        fi = cachemod.fill(state.l1i, line,
-                           jnp.full(T, S, dtype=jnp.int32),
-                           win & is_if, params.l1i.num_sets,
-                           params.l1i.replacement)
-        state = state._replace(l1i=fi.cache)
+            fd = cachemod.fill(state.l1d, line,
+                               jnp.where(is_ex, M, S).astype(jnp.int32),
+                               win & ~is_if, params.l1d.num_sets,
+                               params.l1d.replacement)
+            state = state._replace(l1d=fd.cache)
+            fi = cachemod.fill(state.l1i, line,
+                               jnp.full(T, S, dtype=jnp.int32),
+                               win & is_if, params.l1i.num_sets,
+                               params.l1i.replacement)
+            state = state._replace(l1i=fi.cache)
 
         # ---- counters (all home-binned tallies via dense one-hot sums)
         kcnt = (jnp.sum(inv_bool, axis=1)
@@ -660,10 +751,19 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             dir_forwards=c.dir_forwards
             + _binsum(oh_home, owner_leg & ~act.dram_write, 1),
             dir_evictions=c.dir_evictions + _binsum(oh_home, evicting, 1),
-            dram_reads=c.dram_reads + _binsum(oh_home, need_read, 1),
+            dram_reads=c.dram_reads + _binsum(oh_dsite, need_read, 1),
+            # Under shared L2 a dirty L1 victim flushes into the SLICE
+            # (victim_dirty counts its WB packet below), not DRAM.
             dram_writes=c.dram_writes
-            + _binsum(oh_home, dram_wb, 1)
-            + _binsum(oh_vhome, victim_dirty, 1),
+            + _binsum(oh_dsite, dram_wb, 1)
+            + (0 if params.shared_l2
+               else _binsum(oh_vhome, victim_dirty, 1)),
+            # Shared L2: slice accesses/misses are accounted at the home
+            # tile here (the local kernel never sees an L2).
+            l2_access=c.l2_access + (_binsum(oh_home, win, 1)
+                                     if params.shared_l2 else 0),
+            l2_miss=c.l2_miss + (_binsum(oh_home, win & ~hit, 1)
+                                 if params.shared_l2 else 0),
             net_mem_pkts=c.net_mem_pkts
             + jnp.where(win, 1, 0)                    # request
             + jnp.where(victim_dirty, 1, 0)           # victim WB data
@@ -772,6 +872,66 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     return state
 
 
+class _VictimProbe:
+    """Directory/slice entry located for a batch of dropped lines — the
+    shared plumbing of the eviction-notify paths: per-row home/set, tag
+    match, way select, entry metadata, and the dropping tile's sharer-bit
+    geometry (word index, bit mask, presence)."""
+
+    def __init__(self, params: SimParams, state: SimState, tiles, vtag,
+                 valid):
+        T = params.num_tiles
+        W = state.dir_sharers.shape[0]
+        A = params.directory.associativity
+        ndsets = params.directory.num_sets
+        self.vhome = home_of_line(params, vtag)
+        self.vdset = dir_set_of_line(params, vtag)
+        vfidx = (self.vhome * ndsets + self.vdset).astype(jnp.int32)
+        dtags = state.dir_tags.reshape(A, -1)[:, vfidx].T   # [T, A]
+        dmeta = state.dir_meta.reshape(A, -1)[:, vfidx].T
+        dstate = dir_meta_state(dmeta)
+        match = (dtags == vtag[:, None].astype(jnp.int32)) \
+            & (dstate != I) & valid[:, None]
+        self.found = match.any(axis=1)
+        self.way = jnp.argmax(match, axis=1).astype(jnp.int32)
+        self.meta_way = jnp.take_along_axis(
+            dmeta, self.way[:, None], axis=1)[:, 0]
+        self.est = dir_meta_state(self.meta_way)
+        self.eowner = dir_meta_owner(self.meta_way)
+        self.esharers = jnp.sum(
+            jnp.where((jnp.arange(A, dtype=jnp.int32)[:, None]
+                       == self.way[None, :])[None, :, :],
+                      state.dir_sharers.reshape(W, A, -1)[:, :, vfidx],
+                      jnp.uint64(0)), axis=1, dtype=jnp.uint64).T  # [T, W]
+        self.word = (tiles // 64).astype(jnp.int32)
+        self.bit = jnp.uint64(1) << (tiles % 64).astype(jnp.uint64)
+        self.woh = self.word[:, None] \
+            == jnp.arange(W, dtype=jnp.int32)[None, :]
+        cur = jnp.sum(jnp.where(self.woh, self.esharers, jnp.uint64(0)),
+                      axis=1, dtype=jnp.uint64)
+        self.has_bit = (cur & self.bit) != jnp.uint64(0)
+
+    def set_meta(self, state: SimState, mask, new_state, new_owner):
+        """Rewrite the matched entry's (state, owner) where ``mask``."""
+        T = mask.shape[0]
+        h = jnp.where(mask, self.vhome, T).astype(jnp.int32)
+        return state._replace(
+            dir_meta=state.dir_meta.at[self.way, h, self.vdset].set(
+                dir_pack(new_state, new_owner,
+                         dir_meta_lru(self.meta_way)), mode="drop"))
+
+    def clear_bit(self, state: SimState, mask):
+        """Clear the dropping tile's sharer bit where ``mask`` (guarded
+        commutative subtract — distinct sharers of one entry may clear in
+        the same batch)."""
+        T = mask.shape[0]
+        h = jnp.where(mask & self.has_bit, self.vhome, T).astype(jnp.int32)
+        return state._replace(
+            dir_sharers=state.dir_sharers.at[
+                self.word, self.way, h, self.vdset].add(
+                jnp.uint64(0) - self.bit, mode="drop"))
+
+
 def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
                       vstate, valid) -> SimState:
     """Tell the home directory a tile dropped ``vtag`` from its L2.
@@ -785,69 +945,52 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
     """
     T = params.num_tiles
     W = state.dir_sharers.shape[0]
-    A = params.directory.associativity
-    ndsets = params.directory.num_sets
-    vhome = home_of_line(params, vtag)
-    vdset = dir_set_of_line(params, vtag)
-    vfidx = (vhome * ndsets + vdset).astype(jnp.int32)
-    dtags = state.dir_tags.reshape(A, -1)[:, vfidx].T   # [T, A]
-    dmeta = state.dir_meta.reshape(A, -1)[:, vfidx].T
-    dstate = dir_meta_state(dmeta)
-    match = (dtags == vtag[:, None].astype(jnp.int32)) \
-        & (dstate != I) & valid[:, None]
-    found = match.any(axis=1)
-    way = jnp.argmax(match, axis=1).astype(jnp.int32)
-    meta_way = jnp.take_along_axis(dmeta, way[:, None], axis=1)[:, 0]
-    est = dir_meta_state(meta_way)
-    eowner = dir_meta_owner(meta_way)
-    way_oh = jnp.arange(A, dtype=jnp.int32)[:, None] == way[None, :]
-    esharers = jnp.sum(
-        jnp.where(way_oh[None, :, :],
-                  state.dir_sharers.reshape(W, A, -1)[:, :, vfidx],
-                  jnp.uint64(0)), axis=1, dtype=jnp.uint64).T   # [T, W]
+    p = _VictimProbe(params, state, tiles, vtag, valid)
 
     # Owner dropped its M line: entry -> I.
-    drop_m = found & (est == M) & (eowner == tiles)
+    drop_m = p.found & (p.est == M) & (p.eowner == tiles)
     # Owner dropped its O line (MOSI): owner cleared, sharers remain in S.
-    drop_o = found & (est == O) & (eowner == tiles)
-    # Sharer dropped its S copy (incl. a non-owner sharer of an O entry):
-    # clear its bit (subtract — commutative, so distinct sharers of one
-    # entry may clear in the same batch).
-    word = (tiles // 64).astype(jnp.int32)
-    bit = jnp.uint64(1) << (tiles % 64).astype(jnp.uint64)
-    woh = word[:, None] == jnp.arange(W, dtype=jnp.int32)[None, :]
-    cur = jnp.sum(jnp.where(woh, esharers, jnp.uint64(0)), axis=1,
-                  dtype=jnp.uint64)
-    has_bit = (cur & bit) != jnp.uint64(0)
-    drop_s = found & has_bit \
-        & ((est == S) | ((est == O) & (eowner != tiles)))
+    drop_o = p.found & (p.est == O) & (p.eowner == tiles)
+    # Sharer dropped its S copy (incl. a non-owner sharer of an O entry).
+    drop_s = p.found & p.has_bit \
+        & ((p.est == S) | ((p.est == O) & (p.eowner != tiles)))
     # Last sharer gone -> entry I, so later evictions of the entry don't
     # burn fan-out budget on an empty bitmap.  (Concurrent same-entry drops
     # of one entry in this batch each still see the pre-batch bitmap, so a
     # transient empty-S entry can remain; the evict_s gate tolerates that.)
-    left = esharers & ~jnp.where(woh, bit[:, None], jnp.uint64(0))
+    left = p.esharers & ~jnp.where(p.woh, p.bit[:, None], jnp.uint64(0))
     empty = (left == jnp.uint64(0)).all(axis=1)
 
-    to_i = drop_m | ((drop_s | drop_o) & empty)
-    to_s = drop_o & ~empty
-    hi = jnp.where(to_i, vhome, T).astype(jnp.int32)
-    ho = jnp.where(to_s, vhome, T).astype(jnp.int32)
-    hm = jnp.where(drop_m, vhome, T).astype(jnp.int32)
-    hs = jnp.where(drop_s | drop_o, vhome, T).astype(jnp.int32)
+    state = p.set_meta(state, drop_m | ((drop_s | drop_o) & empty), I, -1)
+    state = p.set_meta(state, drop_o & ~empty, S, -1)
+    # M drop wipes the whole bitmap row (the owner was the only holder).
+    hm = jnp.where(drop_m, p.vhome, T).astype(jnp.int32)
     arW = jnp.arange(W)[:, None]
     state = state._replace(
-        dir_meta=state.dir_meta.at[way, hi, vdset].set(
-            dir_pack(I, -1, dir_meta_lru(meta_way)), mode="drop"),
         dir_sharers=state.dir_sharers.at[
-            arW, way[None, :], hm[None, :], vdset[None, :]].set(
+            arW, p.way[None, :], hm[None, :], p.vdset[None, :]].set(
             jnp.zeros((W, T), dtype=jnp.uint64), mode="drop"))
-    state = state._replace(
-        dir_meta=state.dir_meta.at[way, ho, vdset].set(
-            dir_pack(S, -1, dir_meta_lru(meta_way)), mode="drop"))
-    state = state._replace(
-        dir_sharers=state.dir_sharers.at[word, way, hs, vdset].add(
-            jnp.uint64(0) - bit, mode="drop"))
-    return state
+    return p.clear_bit(state, drop_s | drop_o)
+
+
+def _sh_l1_evict_notify(params: SimParams, state: SimState, tiles, vtag,
+                        vstate, valid) -> SimState:
+    """Report an L1 victim back to its home L2 slice (shared-L2 protocols).
+
+    A dirty (M) L1 victim flushes its data into the slice — the entry
+    drops its owner and becomes O (slice-dirty); a clean exclusive (E)
+    victim releases ownership (entry -> S); a plain S victim just clears
+    its sharer bit.  The slice line itself stays resident — unlike the
+    private-protocol notify, entries never drop to I here (reference:
+    pr_l1_sh_l2_msi l1 writeback into l2_cache_cntlr).
+    """
+    p = _VictimProbe(params, state, tiles, vtag, valid)
+    own_drop = p.found & (p.eowner == tiles) & ((p.est == M) | (p.est == E))
+    # Dirty flush -> slice-dirty O; clean exclusive release -> S.
+    state = p.set_meta(state, own_drop & (vstate == M), O, -1)
+    state = p.set_meta(state, own_drop & (vstate != M), S, -1)
+    # The tile no longer holds the line in any case.
+    return p.clear_bit(state, p.found)
 
 
 # ====================================================================== sync

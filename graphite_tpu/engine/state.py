@@ -230,6 +230,17 @@ def init_periods(params: SimParams) -> np.ndarray:
     return p
 
 
+def _dummy_cache(num_tiles: int) -> cachemod.CacheArrays:
+    """Placeholder private-L2 arrays for shared-L2 protocols (the slice
+    lives in the directory arrays; a full-size private L2 would waste HBM
+    at scale).  Never probed — core/resolve gate on params.shared_l2."""
+    shape = (1, num_tiles, 1)
+    z = jnp.zeros(shape, dtype=jnp.int32)
+    return cachemod.CacheArrays(
+        tags=z, meta=cachemod.pack_meta(z, z),
+        rr_ptr=jnp.zeros((num_tiles, 1), dtype=jnp.int32))
+
+
 def make_state(params: SimParams,
                max_mutexes: int = 64,
                max_barriers: int = 16,
@@ -256,7 +267,8 @@ def make_state(params: SimParams,
         bp_table=jnp.zeros((T, params.core.bp_size), dtype=bool),
         l1i=cachemod.make_cache(T, params.l1i),
         l1d=cachemod.make_cache(T, params.l1d),
-        l2=cachemod.make_cache(T, params.l2),
+        l2=(_dummy_cache(T) if params.shared_l2
+            else cachemod.make_cache(T, params.l2)),
         period_ps=jnp.asarray(init_periods(params)),
         dir_tags=jnp.zeros(d_shape, dtype=jnp.int32),
         dir_meta=dir_pack(

@@ -47,6 +47,7 @@ _TILE_AXIS_BY_FIELD = {
     "dir_sharers": 2,                # [W, A, T, dsets]
     "ch_time": 1,                    # [D, T, T]
     "lq_ready": 1, "sq_ready": 1,    # [entries, T]
+    "link_free_mem": 1,              # [NUM_DIRS, T]
 }
 
 

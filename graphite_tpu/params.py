@@ -357,9 +357,22 @@ class SimParams:
         return self.l2.line_size
 
     @property
+    def shared_l2(self) -> bool:
+        """True for the shared-distributed-L2 protocols: the directory
+        arrays ARE the per-tile L2 slices (directory integrated in L2,
+        reference pr_l1_sh_l2_msi/l2_cache_cntlr.cc + l2_directory_cfg.cc),
+        and there is no private L2."""
+        return self.protocol.startswith("pr_l1_sh_l2")
+
+    @property
     def protocol_kind(self) -> str:
-        """Directory FSM family of the selected protocol: 'msi' | 'mosi'."""
-        return "mosi" if self.protocol.endswith("_mosi") else "msi"
+        """Directory FSM family: 'msi' | 'mosi' | 'sh_l2_msi' | 'sh_l2_mesi'."""
+        return {
+            "pr_l1_pr_l2_dram_directory_msi": "msi",
+            "pr_l1_pr_l2_dram_directory_mosi": "mosi",
+            "pr_l1_sh_l2_msi": "sh_l2_msi",
+            "pr_l1_sh_l2_mesi": "sh_l2_mesi",
+        }[self.protocol]
 
     def __post_init__(self):
         sizes = {self.l1i.line_size, self.l1d.line_size, self.l2.line_size}
@@ -383,9 +396,14 @@ class SimParams:
                       "core/iocoom/num_store_queue_entries")
         _check("caching_protocol/type", self.protocol,
                {"pr_l1_pr_l2_dram_directory_msi",
-                "pr_l1_pr_l2_dram_directory_mosi"})
-        _check("dram_directory/directory_type",
-               self.directory.directory_type, {"full_map"})
+                "pr_l1_pr_l2_dram_directory_mosi",
+                "pr_l1_sh_l2_msi", "pr_l1_sh_l2_mesi"})
+        if self.shared_l2:
+            _check("l2_directory/directory_type", self.l2_directory_type,
+                   {"full_map"})
+        else:
+            _check("dram_directory/directory_type",
+                   self.directory.directory_type, {"full_map"})
         _check("network/user model", self.net_user.model,
                {"magic", "emesh_hop_counter"})
         _check("network/memory model", self.net_memory.model,
@@ -420,7 +438,25 @@ class SimParams:
         l2 = CacheParams.from_config(cfg, f"l2_cache/{l2_name}", "l2_cache")
 
         dram = DramParams.from_config(cfg, T)
-        directory = DirectoryParams.from_config(cfg, T, l2, num_slices=dram.num_controllers)
+        protocol = cfg.get_str("caching_protocol/type")
+        if protocol.startswith("pr_l1_sh_l2"):
+            # Shared-distributed L2: the "directory" is the per-tile L2
+            # slice itself (tags + state + L1-sharer tracking), so its
+            # geometry and access latency come from the L2 cache config
+            # and the sharer-tracking knobs from [l2_directory]
+            # (reference: l2_directory_cfg.cc, l2_cache_cntlr.cc).
+            directory = DirectoryParams(
+                total_entries=l2.num_sets * l2.associativity,
+                associativity=l2.associativity,
+                max_hw_sharers=cfg.get_int("l2_directory/max_hw_sharers"),
+                directory_type=cfg.get_str("l2_directory/directory_type"),
+                access_cycles=l2.access_cycles,
+                limitless_trap_cycles=cfg.get_int(
+                    "limitless/software_trap_penalty"),
+            )
+        else:
+            directory = DirectoryParams.from_config(
+                cfg, T, l2, num_slices=dram.num_controllers)
 
         scheme = cfg.get_str("clock_skew_management/scheme")
         if scheme == "lax_p2p":
@@ -438,7 +474,7 @@ class SimParams:
             l1i=l1i,
             l1d=l1d,
             l2=l2,
-            protocol=cfg.get_str("caching_protocol/type"),
+            protocol=protocol,
             l2_directory_type=cfg.get_str("l2_directory/directory_type"),
             l2_max_hw_sharers=cfg.get_int("l2_directory/max_hw_sharers"),
             directory=directory,
