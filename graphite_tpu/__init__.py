@@ -32,4 +32,4 @@ _jax.config.update("jax_enable_x64", True)
 
 __version__ = "0.1.0"
 
-from graphite_tpu.config import Config, load_config  # noqa: E402,F401
+from graphite_tpu.config import Config, ConfigError, load_config  # noqa: E402,F401

@@ -51,6 +51,23 @@ def bitmap_to_bool(words: jnp.ndarray, num_tiles: int) -> jnp.ndarray:
     return bits.reshape(K, W * 64)[:, :num_tiles].astype(bool)
 
 
+def lowest_bit(words: jnp.ndarray) -> jnp.ndarray:
+    """[K, W] uint64 -> [K, W] bitmap with only the lowest set bit kept
+    (all-zero rows stay zero).  Used to pick the deterministic victim
+    sharer of limited_no_broadcast pointer overflow (reference:
+    directory_entry_limited_no_broadcast.cc picks one sharer to evict)."""
+    K, W = words.shape
+    out = jnp.zeros_like(words)
+    taken = jnp.zeros(K, dtype=bool)
+    for w in range(W):
+        x = words[:, w]
+        b = x & (~x + jnp.uint64(1))
+        use = ~taken & (x != jnp.uint64(0))
+        out = out.at[:, w].set(jnp.where(use, b, out[:, w]))
+        taken = taken | (x != jnp.uint64(0))
+    return out
+
+
 def popcount(words: jnp.ndarray) -> jnp.ndarray:
     """[K, W] uint64 -> [K] int32 number of set bits."""
     # jnp.bitwise_count is available in recent jax; fall back to manual.
@@ -81,7 +98,7 @@ class MsiActions(NamedTuple):
 
 def transition(protocol_kind: str, is_ex: jnp.ndarray, requester: jnp.ndarray,
                state: jnp.ndarray, owner: jnp.ndarray, sharers: jnp.ndarray,
-               num_words: int) -> MsiActions:
+               num_words: int, is_ifetch: jnp.ndarray = None) -> MsiActions:
     """Dispatch the directory FSM by (static) protocol kind — the factory
     boundary of MemoryManager::createMMU (memory_manager.cc:29-52)."""
     if protocol_kind == "mosi":
@@ -89,7 +106,8 @@ def transition(protocol_kind: str, is_ex: jnp.ndarray, requester: jnp.ndarray,
                                num_words)
     if protocol_kind in ("sh_l2_msi", "sh_l2_mesi"):
         return sh_l2_transition(protocol_kind == "sh_l2_mesi", is_ex,
-                                requester, state, owner, sharers, num_words)
+                                requester, state, owner, sharers, num_words,
+                                no_e_grant=is_ifetch)
     return msi_transition(is_ex, requester, state, owner, sharers, num_words)
 
 
@@ -220,7 +238,8 @@ def mosi_transition(is_ex: jnp.ndarray, requester: jnp.ndarray,
 
 def sh_l2_transition(mesi: bool, is_ex: jnp.ndarray, requester: jnp.ndarray,
                      state: jnp.ndarray, owner: jnp.ndarray,
-                     sharers: jnp.ndarray, num_words: int) -> MsiActions:
+                     sharers: jnp.ndarray, num_words: int,
+                     no_e_grant: jnp.ndarray = None) -> MsiActions:
     """The shared-distributed-L2 slice FSM (reference:
     pr_l1_sh_l2_msi/l2_cache_cntlr.cc + dram_directory integrated in L2;
     MESI variant pr_l1_sh_l2_mesi/).
@@ -248,10 +267,17 @@ def sh_l2_transition(mesi: bool, is_ex: jnp.ndarray, requester: jnp.ndarray,
     # its flushed-back data is conservatively treated as dirty (entry ->
     # O, like M): the slice can't know, and assuming clean would skip the
     # DRAM writeback the reference performs when the owner HAD written.
-    sh_miss_state = jnp.full_like(state, E if mesi else S)
+    grant_e = miss & mesi
+    if no_e_grant is not None:
+        # Instruction fetches never take L1D ownership (the line fills L1I
+        # in S); granting E would record an owner that later charges a
+        # phantom flush leg.
+        grant_e = grant_e & ~no_e_grant
+    sh_miss_state = jnp.where(grant_e, E, S) if mesi \
+        else jnp.full_like(state, S)
     sh_state = jnp.where(miss, sh_miss_state,
                          jnp.where((state == M) | (state == E), O, state))
-    sh_owner = jnp.where(miss & mesi, requester.astype(jnp.int32), -1)
+    sh_owner = jnp.where(grant_e, requester.astype(jnp.int32), -1)
     sh_sharers = jnp.where(
         ((state == M) | (state == E))[:, None],
         own_bit | req_bit, sharers | req_bit)

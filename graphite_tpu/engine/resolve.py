@@ -343,7 +343,60 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             & (vsharers != jnp.uint64(0)).any(axis=1)
 
         act = dirmod.transition(params.protocol_kind, is_ex, rows,
-                                entry_state, entry_owner, entry_sharers, W)
+                                entry_state, entry_owner, entry_sharers, W,
+                                is_ifetch=is_if)
+
+        # ---- limited directory schemes (reference: directory_schemes/
+        # directory_entry_{limited_broadcast,limited_no_broadcast,ackwise,
+        # limitless}.cc).  The engine stores the exact full bitmap; each
+        # scheme contributes its BEHAVIORAL delta on top:
+        #   limited_no_broadcast — an add past max_hw_sharers first
+        #     invalidates a victim sharer (pointer eviction), so tracked
+        #     sharers never exceed the cap;
+        #   limitless — an access to an entry past the hardware pointer
+        #     budget traps to software (software_trap_penalty directory
+        #     cycles); sharer knowledge stays exact (software keeps it);
+        #   limited_broadcast — an overflowed entry's invalidation must
+        #     broadcast: latency spans ALL tiles and T-1 INV packets go
+        #     out (every tile acks);
+        #   ackwise — broadcast sends (T-1 packets) but acks are counted
+        #     from the true sharers, so latency matches full_map.
+        scheme = params.directory.directory_type
+        k_hw = params.directory.max_hw_sharers
+        scheme_dir_ps = jnp.int64(0)
+        bcast_lat = bcast_traffic = None
+        if scheme != "full_map":
+            # Pointer pressure excludes the requester's own already-set
+            # bit: a tracked sharer re-requesting consumes no new pointer
+            # (no victim eviction, no software trap).
+            req_bits = dirmod.make_tile_bit(rows, W)
+            others = entry_sharers & ~req_bits
+            n_sh = dirmod.popcount(others)
+            if scheme == "limitless":
+                scheme_dir_ps = jnp.where(
+                    hit & (n_sh >= k_hw),
+                    _lat(params.directory.limitless_trap_cycles,
+                         p_dir_home), 0)
+            elif scheme == "limited_no_broadcast":
+                cand = others
+                overflow_add = ~is_ex & (n_sh >= k_hw) \
+                    & (cand != jnp.uint64(0)).any(axis=1)
+                vbit = dirmod.lowest_bit(cand)
+                act = act._replace(
+                    inv_targets=jnp.where(overflow_add[:, None],
+                                          act.inv_targets | vbit,
+                                          act.inv_targets),
+                    new_sharers=jnp.where(overflow_add[:, None],
+                                          act.new_sharers & ~vbit,
+                                          act.new_sharers))
+            elif scheme in ("limited_broadcast", "ackwise"):
+                # Overflow is about TOTAL tracked pointers (the
+                # requester's own bit occupies one too).
+                overflowed = dirmod.popcount(entry_sharers) > k_hw
+                bcast_traffic = overflowed
+                if scheme == "limited_broadcast":
+                    bcast_lat = overflowed
+
         has_inv = win & (act.inv_targets != jnp.uint64(0)).any(axis=1)
         owner = act.owner_tile
         vown_c = jnp.maximum(vowner, 0)
@@ -424,6 +477,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             axis=1, dtype=jnp.uint64)
         inv_bool = dirmod.bitmap_to_bool(inv_words, T)   # [K, T]
         vic_bool = dirmod.bitmap_to_bool(vic_words, T)   # [K, T]
+        if bcast_lat is not None:
+            # limited_broadcast overflow: the INV broadcast's completion
+            # waits on acks from EVERY tile, not just the true sharers.
+            bl_k = jnp.any(oh_sr & (bcast_lat & has_inv)[None, :], axis=1)
+            inv_bool = inv_bool | bl_k[:, None]
 
         home_sr = sr_sel(home)
         pnh_sr = sr_sel(p_net_home.astype(jnp.int64)).astype(jnp.int32)
@@ -444,7 +502,16 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         oh_vown = _oh(vown_c, T)
         p_net_vown = _sel(oh_vown, p_net).astype(jnp.int32)
         p_l2_vown = _sel(oh_vown, p_l2).astype(jnp.int32)
-        l2_vown_ps = _lat(params.l2.access_cycles, p_l2_vown)
+        # Owner-side lookup cost for flush/downgrade legs: the owner holds
+        # the line in its private L2 — or only in its L1D under shared L2
+        # (there is no private L2 there).
+        if params.shared_l2:
+            oh_vown_l1 = _oh(vown_c, T)
+            l2_vown_ps = _lat(params.l1d.access_cycles, _sel(
+                oh_vown_l1, _period(state, DVFSModule.L1_DCACHE)).astype(
+                    jnp.int32))
+        else:
+            l2_vown_ps = _lat(params.l2.access_cycles, p_l2_vown)
 
         # ---- latency assembly (SURVEY.md 3.3's round trips).  Unicast
         # legs are either zero-load closed forms (magic/emesh_hop_counter)
@@ -506,13 +573,20 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                                  evict_ps)
 
         # Replacement of a live victim entry completes before the new
-        # request is served.
-        t_dir = arrive + dir_ps + jnp.where(evicting, evict_ps, 0)
+        # request is served.  scheme_dir_ps adds the limitless software
+        # trap where the entry overflowed its hardware pointers.
+        t_dir = arrive + dir_ps + scheme_dir_ps \
+            + jnp.where(evicting, evict_ps, 0)
 
         oh_owner = _oh(owner, T)
         p_net_own = _sel(oh_owner, p_net).astype(jnp.int32)
         p_l2_own = _sel(oh_owner, p_l2).astype(jnp.int32)
-        l2_own_ps = _lat(params.l2.access_cycles, p_l2_own)
+        if params.shared_l2:
+            l2_own_ps = _lat(params.l1d.access_cycles, _sel(
+                oh_owner, _period(state, DVFSModule.L1_DCACHE)).astype(
+                    jnp.int32))
+        else:
+            l2_own_ps = _lat(params.l2.access_cycles, p_l2_own)
         if contended:
             g1 = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,
@@ -688,7 +762,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             # path, so no latency/link-contention charge) — it lands in
             # the slice, not DRAM.
             victim_dirty = win & ~is_if & (fd.victim_state == M)
-            oh_vhome = _oh(home_of_line(params, fd.victim_tag), T)
+            oh_vhome = None   # dram_writes never home-bins L1->slice WBs
             state = _sh_l1_evict_notify(
                 params, state, rows, fd.victim_tag, fd.victim_state,
                 win & ~is_if & (fd.victim_state != I))
@@ -737,8 +811,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             state = state._replace(l1i=fi.cache)
 
         # ---- counters (all home-binned tallies via dense one-hot sums)
-        kcnt = (jnp.sum(inv_bool, axis=1)
-                + jnp.sum(vic_bool, axis=1)).astype(jnp.int64)  # [K]
+        kcnt_inv = jnp.sum(inv_bool, axis=1).astype(jnp.int64)  # [K]
+        if bcast_traffic is not None:
+            # Broadcast schemes put T-1 INV packets on the wire for an
+            # overflowed entry regardless of the true sharer count.
+            bt_k = jnp.any(oh_sr & (bcast_traffic & has_inv)[None, :],
+                           axis=1)
+            kcnt_inv = jnp.where(bt_k, T - 1, kcnt_inv)
+        kcnt = kcnt_inv + jnp.sum(vic_bool, axis=1).astype(jnp.int64)
         inv_count = jnp.sum(jnp.where(oh_sr, kcnt[:, None], 0), axis=0)
         c = state.counters
         c = c._replace(

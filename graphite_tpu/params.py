@@ -398,12 +398,17 @@ class SimParams:
                {"pr_l1_pr_l2_dram_directory_msi",
                 "pr_l1_pr_l2_dram_directory_mosi",
                 "pr_l1_sh_l2_msi", "pr_l1_sh_l2_mesi"})
-        if self.shared_l2:
-            _check("l2_directory/directory_type", self.l2_directory_type,
-                   {"full_map"})
-        else:
-            _check("dram_directory/directory_type",
-                   self.directory.directory_type, {"full_map"})
+        # Validate the OPERATIVE scheme field (directory.directory_type is
+        # what the engine reads; it is sourced from [l2_directory] under
+        # shared L2 and [dram_directory] otherwise).
+        _schemes = {"full_map", "limited_broadcast", "limited_no_broadcast",
+                    "ackwise", "limitless"}
+        _check("l2_directory/directory_type" if self.shared_l2
+               else "dram_directory/directory_type",
+               self.directory.directory_type, _schemes)
+        if self.directory.directory_type != "full_map":
+            _positive(self.directory.max_hw_sharers,
+                      "directory max_hw_sharers")
         _check("network/user model", self.net_user.model,
                {"magic", "emesh_hop_counter"})
         _check("network/memory model", self.net_memory.model,

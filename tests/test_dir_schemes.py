@@ -1,0 +1,123 @@
+"""Limited directory scheme tests (reference: common/tile/memory_subsystem/
+directory_schemes/directory_entry_{limited_broadcast,limited_no_broadcast,
+ackwise,limitless}.cc).
+
+Each scheme's characteristic signature vs full_map, on the same trace:
+  limited_no_broadcast — tracked sharers never exceed the cap; pointer
+      overflow invalidates a victim sharer (extra INV traffic);
+  limitless — sharers stay exact but overflowed entries pay the software
+      trap (longer completion);
+  limited_broadcast — overflowed invalidation broadcasts: T-1 packets and
+      all-tile ack latency;
+  ackwise — broadcast traffic (T-1 packets) at full_map latency.
+"""
+
+import numpy as np
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator, run_simulation
+from graphite_tpu.events.schema import TraceBuilder
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+T = 6
+
+
+def make_params(scheme, k=2, tiles=T):
+    cfg = load_config()
+    cfg.set("general/total_cores", tiles)
+    cfg.set("dram_directory/directory_type", scheme)
+    cfg.set("dram_directory/max_hw_sharers", k)
+    return SimParams.from_config(cfg)
+
+
+def _readers_then_writer(readers=3, writer=3):
+    """Tiles 0..readers-1 read one line in sequence; `writer` then writes."""
+    tb = TraceBuilder(T)
+    addr = synth.SHARED_BASE
+    for r in range(readers):
+        tb.stall_until(r, 2_000_000 * (r + 1))
+        tb.read(r, addr, 8)
+    tb.stall_until(writer, 2_000_000 * (readers + 2))
+    tb.write(writer, addr, 8)
+    return tb.build()
+
+
+def counters_np(s):
+    return {key: v for key, v in s.counters.items()}
+
+
+def _sharer_popcounts(sim):
+    sh = np.moveaxis(np.asarray(sim.state.dir_sharers), 0, -1)  # [A,T,ds,W]
+    return np.array([bin(int(w)).count("1")
+                     for w in sh.reshape(-1, sh.shape[-1])[:, 0]])
+
+
+def test_limited_no_broadcast_caps_sharers():
+    params = make_params("limited_no_broadcast", k=2)
+    tb = TraceBuilder(T)
+    addr = synth.SHARED_BASE
+    for r in range(5):
+        tb.stall_until(r, 2_000_000 * (r + 1))
+        tb.read(r, addr, 8)
+    trace = tb.build()
+    sim = Simulator(params, trace)
+    s = sim.run()
+    c = counters_np(s)
+    # 3rd..5th reader each evicted one victim sharer
+    assert int(c["dir_invalidations"].sum()) == 3
+    assert _sharer_popcounts(sim).max() <= 2
+    # full_map on the same trace: no invalidations, all 5 tracked
+    sim_f = Simulator(make_params("full_map"), trace)
+    sim_f.run()
+    assert int(counters_np(sim_f.summary())["dir_invalidations"].sum()) == 0
+    assert _sharer_popcounts(sim_f).max() == 5
+
+
+def test_limitless_trap_slows_overflowed_entries():
+    trace = _readers_then_writer(readers=5, writer=5)
+    s_lim = run_simulation(make_params("limitless", k=2), trace)
+    s_full = run_simulation(make_params("full_map"), trace)
+    # sharer knowledge stays exact -> same invalidation count ...
+    assert int(counters_np(s_lim)["dir_invalidations"].sum()) \
+        == int(counters_np(s_full)["dir_invalidations"].sum())
+    # ... but overflowed accesses paid the software trap
+    assert s_lim.completion_time_ps > s_full.completion_time_ps
+
+
+def test_limited_broadcast_traffic_and_latency():
+    trace = _readers_then_writer(readers=3, writer=3)
+    c_b = counters_np(run_simulation(
+        make_params("limited_broadcast", k=1), trace))
+    c_f = counters_np(run_simulation(make_params("full_map"), trace))
+    # full_map invalidates the 3 true sharers; broadcast sends T-1 = 5
+    assert int(c_f["dir_invalidations"].sum()) == 3
+    assert int(c_b["dir_invalidations"].sum()) == T - 1
+
+
+def test_ackwise_broadcast_traffic_fullmap_latency():
+    trace = _readers_then_writer(readers=3, writer=3)
+    s_a = run_simulation(make_params("ackwise", k=1), trace)
+    s_f = run_simulation(make_params("full_map"), trace)
+    # broadcast traffic ...
+    assert int(counters_np(s_a)["dir_invalidations"].sum()) == T - 1
+    # ... at true-sharer ack latency: completion identical to full_map
+    assert s_a.completion_time_ps == s_f.completion_time_ps
+
+
+def test_under_cap_entries_behave_like_fullmap():
+    """Entries below the pointer cap must be bit-identical to full_map in
+    both time and traffic, for every scheme."""
+    tb = TraceBuilder(T)
+    addr = synth.SHARED_BASE
+    tb.read(0, addr, 8)
+    tb.stall_until(1, 5_000_000)
+    tb.write(1, addr, 8)
+    trace = tb.build()
+    s_f = run_simulation(make_params("full_map"), trace)
+    for scheme in ("limited_no_broadcast", "limitless",
+                   "limited_broadcast", "ackwise"):
+        s = run_simulation(make_params(scheme, k=4), trace)
+        assert s.completion_time_ps == s_f.completion_time_ps, scheme
+        assert int(counters_np(s)["dir_invalidations"].sum()) \
+            == int(counters_np(s_f)["dir_invalidations"].sum()), scheme
