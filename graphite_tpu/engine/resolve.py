@@ -501,16 +501,15 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # M-state victim: single-owner flush round trip.
         oh_vown = _oh(vown_c, T)
         p_net_vown = _sel(oh_vown, p_net).astype(jnp.int32)
-        p_l2_vown = _sel(oh_vown, p_l2).astype(jnp.int32)
         # Owner-side lookup cost for flush/downgrade legs: the owner holds
         # the line in its private L2 — or only in its L1D under shared L2
         # (there is no private L2 there).
         if params.shared_l2:
-            oh_vown_l1 = _oh(vown_c, T)
             l2_vown_ps = _lat(params.l1d.access_cycles, _sel(
-                oh_vown_l1, _period(state, DVFSModule.L1_DCACHE)).astype(
+                oh_vown, _period(state, DVFSModule.L1_DCACHE)).astype(
                     jnp.int32))
         else:
+            p_l2_vown = _sel(oh_vown, p_l2).astype(jnp.int32)
             l2_vown_ps = _lat(params.l2.access_cycles, p_l2_vown)
 
         # ---- latency assembly (SURVEY.md 3.3's round trips).  Unicast
@@ -580,13 +579,13 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
 
         oh_owner = _oh(owner, T)
         p_net_own = _sel(oh_owner, p_net).astype(jnp.int32)
-        p_l2_own = _sel(oh_owner, p_l2).astype(jnp.int32)
         if params.shared_l2:
             l2_own_ps = _lat(params.l1d.access_cycles, _sel(
                 oh_owner, _period(state, DVFSModule.L1_DCACHE)).astype(
                     jnp.int32))
         else:
-            l2_own_ps = _lat(params.l2.access_cycles, p_l2_own)
+            l2_own_ps = _lat(params.l2.access_cycles,
+                             _sel(oh_owner, p_l2).astype(jnp.int32))
         if contended:
             g1 = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,

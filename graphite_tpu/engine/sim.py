@@ -37,6 +37,7 @@ class SimSummary:
         self.steps = steps
         self.clock = np.asarray(state.clock)
         self.done = np.asarray(state.done)
+        self.period_ps = np.asarray(state.period_ps)
         self.counters: Dict[str, np.ndarray] = {
             f: np.asarray(getattr(state.counters, f))
             for f in state.counters._fields
@@ -58,9 +59,16 @@ class SimSummary:
             return float("inf")
         return self.total_instructions / self.host_seconds / 1e6
 
+    def energy(self):
+        """Analytic McPAT/DSENT-shaped energy breakdown (graphite_tpu.
+        energy) on the final counters at each module's current V/f."""
+        from graphite_tpu.energy import compute_energy
+        return compute_energy(self.params, self.counters,
+                              self.completion_time_ps, self.period_ps)
+
     def to_dict(self) -> Dict:
         agg = {k: int(v.sum()) for k, v in self.counters.items()}
-        return {
+        out = {
             "num_tiles": self.params.num_tiles,
             "completion_time_ns": ps_to_ns(self.completion_time_ps),
             "host_seconds": self.host_seconds,
@@ -70,6 +78,9 @@ class SimSummary:
             "all_done": bool(self.done.all()),
             "aggregate": agg,
         }
+        if self.params.enable_power_modeling:
+            out["energy"] = self.energy().to_dict()
+        return out
 
     def render(self) -> str:
         c = self.counters
@@ -126,6 +137,18 @@ class SimSummary:
         lines.append("[stalls]")
         row("Memory Stall (in ns, total)", f"{ps_to_ns(agg['mem_stall_ps']):.1f}")
         row("Sync Stall (in ns, total)", f"{ps_to_ns(agg['sync_stall_ps']):.1f}")
+        if self.params.enable_power_modeling:
+            e = self.energy()
+            seconds = max(self.completion_time_ps * 1e-12, 1e-30)
+            lines.append("[energy]")
+            for name in ("core", "l1i", "l1d", "l2", "directory", "dram",
+                         "network", "leakage"):
+                row(f"{name.capitalize()} Energy (in uJ)",
+                    f"{float(getattr(e, name).sum()) * 1e6:.3f}")
+            row("Total Energy (in uJ)", f"{float(e.total.sum()) * 1e6:.3f}")
+            row("Average Power (in W)",
+                f"{float(e.total.sum()) / seconds:.3f}")
+            row("Tile Area (in mm^2)", f"{e.area_mm2_per_tile:.3f}")
         return "\n".join(lines) + "\n"
 
 

@@ -409,6 +409,10 @@ class SimParams:
         if self.directory.directory_type != "full_map":
             _positive(self.directory.max_hw_sharers,
                       "directory max_hw_sharers")
+        if self.enable_power_modeling:
+            from graphite_tpu.energy import DVFS_LEVELS
+            _check("general/technology_node", self.technology_node,
+                   set(DVFS_LEVELS))
         _check("network/user model", self.net_user.model,
                {"magic", "emesh_hop_counter"})
         _check("network/memory model", self.net_memory.model,

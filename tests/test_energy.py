@@ -1,0 +1,102 @@
+"""Energy/power/area accounting tests (analytic McPAT/CACTI/DSENT role).
+
+Pin the scaling behaviors the reference exposes (reference:
+common/mcpat/mcpat_core_interface.h, technology/dvfs_levels_*.cfg,
+tile_energy_monitor.cc): discrete DVFS voltage levels per node, V^2
+dynamic scaling, technology-node scaling, counters-driven breakdown.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigError, load_config
+from graphite_tpu.energy import (DVFS_LEVELS, compute_energy,
+                                 nominal_voltage, voltage_for_frequency)
+from graphite_tpu.engine.sim import run_simulation
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+
+def make_params(tiles=4, **over):
+    cfg = load_config()
+    cfg.set("general/total_cores", tiles)
+    cfg.set("general/enable_power_modeling", "true")
+    for k, v in over.items():
+        cfg.set(k, v)
+    return SimParams.from_config(cfg)
+
+
+def test_voltage_levels_lookup():
+    # top level at max frequency, nominal voltage
+    assert voltage_for_frequency(2.0, 2.0, 45) == 1.1
+    # reduced frequency steps down the discrete ladder
+    assert voltage_for_frequency(1.0, 2.0, 45) == 0.94    # factor .54
+    assert voltage_for_frequency(0.8, 2.0, 45) == 0.9     # factor .42
+    # vectorized
+    v = voltage_for_frequency(np.array([2.0, 1.0]), 2.0, 22)
+    assert list(v) == [1.0, 0.84]
+    # over the top level: loud failure
+    with pytest.raises(ConfigError):
+        voltage_for_frequency(2.5, 2.0, 45)
+    with pytest.raises(ConfigError):
+        voltage_for_frequency(1.0, 2.0, 16)   # unknown node
+
+
+def test_levels_monotonic():
+    for node, levels in DVFS_LEVELS.items():
+        volts = [v for v, _ in levels]
+        factors = [f for _, f in levels]
+        assert volts == sorted(volts, reverse=True), node
+        assert factors == sorted(factors, reverse=True), node
+        assert factors[0] == 1.0, node
+
+
+def _run_energy(**over):
+    params = make_params(4, **over)
+    trace = synth.gen_radix(4, keys_per_tile=64, radix=16)
+    s = run_simulation(params, trace)
+    return params, s, s.energy()
+
+
+def test_breakdown_positive_and_consistent():
+    params, s, e = _run_energy()
+    d = e.to_dict()
+    for name in ("core", "l1i", "l1d", "l2", "dram", "leakage"):
+        assert d[name] > 0, name
+    assert d["total"] == pytest.approx(d["dynamic_total"] + d["leakage"])
+    assert abs(d["dynamic_total"]
+               - sum(d[n] for n in ("core", "l1i", "l1d", "l2",
+                                    "directory", "dram", "network"))) \
+        < 1e-18
+    # summary render carries the section
+    out = s.render()
+    assert "[energy]" in out and "Average Power" in out
+    assert "energy" in s.to_dict()
+
+
+def test_technology_node_scaling():
+    _, _, e45 = _run_energy(**{"general/technology_node": 45})
+    _, _, e22 = _run_energy(**{"general/technology_node": 22})
+    # same counters, smaller node -> lower dynamic energy
+    assert float(e22.dynamic_total.sum()) < float(e45.dynamic_total.sum())
+    assert e22.area_mm2_per_tile < e45.area_mm2_per_tile
+
+
+def test_dvfs_voltage_scales_dynamic_energy():
+    """Same trace at half the domain frequency: lower discrete voltage,
+    strictly less dynamic energy per event (V^2), while counters agree."""
+    full = "<1.0, CORE, L1_ICACHE, L1_DCACHE, L2_CACHE, DIRECTORY, " \
+           "NETWORK_USER, NETWORK_MEMORY>"
+    half = full.replace("1.0", "0.8")
+    p1, s1, e1 = _run_energy(**{"dvfs/domains": full})
+    p2, s2, e2 = _run_energy(**{"dvfs/domains": half})
+    c1 = {k: v.sum() for k, v in s1.counters.items()}
+    c2 = {k: v.sum() for k, v in s2.counters.items()}
+    assert int(c1["icount"]) == int(c2["icount"])
+    assert float(e2.core.sum()) < float(e1.core.sum())
+
+
+def test_energy_across_protocols():
+    for proto in ("pr_l1_pr_l2_dram_directory_mosi", "pr_l1_sh_l2_mesi"):
+        _, s, e = _run_energy(**{"caching_protocol/type": proto})
+        assert float(e.total.sum()) > 0, proto
