@@ -32,8 +32,9 @@ from graphite_tpu.engine import cache as cachemod
 from graphite_tpu.engine import dense
 from graphite_tpu.engine import noc
 from graphite_tpu.engine.state import (
-    PEND_BARRIER, PEND_EX_REQ, PEND_IFETCH, PEND_MUTEX, PEND_NONE,
-    PEND_RECV, PEND_SEND, PEND_SH_REQ, SimState, TraceArrays)
+    PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
+    PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
+    PEND_START, SimState, TraceArrays)
 from graphite_tpu.events.schema import ICACHE_BYTES_PER_INSTRUCTION
 from graphite_tpu.isa import DVFSModule, EventOp
 from graphite_tpu.params import SimParams
@@ -94,6 +95,10 @@ def local_advance(params: SimParams, state: SimState,
                         | (op == EventOp.BARRIER_WAIT)
                         | (op == EventOp.MUTEX_LOCK)
                         | (op == EventOp.MUTEX_UNLOCK)
+                        | (op == EventOp.COND_WAIT)
+                        | (op == EventOp.COND_SIGNAL)
+                        | (op == EventOp.COND_BROADCAST)
+                        | (op == EventOp.JOIN)
                         | (op == EventOp.RECV)
                         | (op == EventOp.SEND)
                         | (op == EventOp.SYNC)
@@ -241,18 +246,41 @@ def local_advance(params: SimParams, state: SimState,
         bar_time = jnp.maximum(st.bar_time, dense.binmax(
             bar_oh, is_bar, clk + to_mcp_ps, NEG))
         # unlock: release the mutex at MCP-arrival time; requester pays the
-        # round trip (SyncClient blocks on the ack, sync_client.h:10-30)
-        lock_id = jnp.clip(arg, 0, num_locks - 1)
-        ul_oh = dense.onehot(lock_id, num_locks) & is_unlock[:, None]
+        # round trip (SyncClient blocks on the ack, sync_client.h:10-30).
+        # COND_WAIT releases its held mutex the same way (SimCond::wait
+        # calls unlock, sync_server.cc:73) — its lock id is in arg2.
+        is_cwait = op == EventOp.COND_WAIT
+        is_csig = op == EventOp.COND_SIGNAL
+        is_cbc = op == EventOp.COND_BROADCAST
+        is_join = op == EventOp.JOIN
+        is_tstart = op == EventOp.THREAD_START
+        release = is_unlock | is_cwait
+        lock_id = jnp.clip(jnp.where(is_cwait, arg2, arg), 0, num_locks - 1)
+        ul_oh = dense.onehot(lock_id, num_locks) & release[:, None]
         lock_holder = jnp.where(ul_oh.any(axis=0), 0, st.lock_holder)
         lock_free_at = jnp.maximum(st.lock_free_at, dense.binmax(
-            ul_oh, is_unlock, clk + to_mcp_ps + cycle_ps, NEG))
+            ul_oh, release, clk + to_mcp_ps + cycle_ps, NEG))
         dt_unlock = 2 * to_mcp_ps + 2 * cycle_ps
+
+        # cond signal/broadcast: the poster PARKS as the token itself
+        # (PEND_CSIG/PEND_CBC with its MCP-arrival timestamp); resolve_cond
+        # matches tokens to waiters in exact time order and acks the
+        # poster with a timestamp-based completion (SimCond::signal/
+        # broadcast, sync_server.cc:76-119).
+
+        # spawn: start the child's stream once the spawn request lands on
+        # its tile (ThreadManager::spawnThread -> masterSpawnThread path).
+        is_spawn = op == EventOp.SPAWN
+        child = jnp.clip(arg2, 0, T - 1)
+        spawn_land = clk + _lat(jnp.maximum(arg, 0), p_core) \
+            + noc.unicast_ps(params.net_user, rows, child, 8, p_nu,
+                             params.mesh_width)
+        spawned_at = jnp.maximum(st.spawned_at, dense.binmax(
+            dense.onehot(child, T), is_spawn, spawn_land, NEG))
 
         # ------------------------------------------------ SIMPLE/DYNAMIC OPS
         is_stall = op == EventOp.STALL
         is_sync = op == EventOp.SYNC
-        is_spawn = op == EventOp.SPAWN
         is_dvfs = op == EventOp.DVFS_SET
         is_done = op == EventOp.DONE
         dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
@@ -287,7 +315,8 @@ def local_advance(params: SimParams, state: SimState,
 
         # ------------------------------------------------- blocking events
         blocked = comp_block | mem_rem | is_recv | is_bar | is_lock \
-            | send_block
+            | send_block | is_cwait | is_csig | is_cbc | is_join \
+            | is_tstart
         kind = jnp.where(comp_block, PEND_IFETCH, PEND_NONE)
         kind = jnp.where(mem_rem & is_rd, PEND_SH_REQ, kind)
         kind = jnp.where(mem_rem & is_wr, PEND_EX_REQ, kind)
@@ -295,10 +324,16 @@ def local_advance(params: SimParams, state: SimState,
         kind = jnp.where(is_bar, PEND_BARRIER, kind)
         kind = jnp.where(is_lock, PEND_MUTEX, kind)
         kind = jnp.where(send_block, PEND_SEND, kind)
+        kind = jnp.where(is_cwait, PEND_COND, kind)
+        kind = jnp.where(is_csig, PEND_CSIG, kind)
+        kind = jnp.where(is_cbc, PEND_CBC, kind)
+        kind = jnp.where(is_join, PEND_JOIN, kind)
+        kind = jnp.where(is_tstart, PEND_START, kind)
         pend_kind = jnp.where(blocked, kind, st.pend_kind)
-        pend_addr = jnp.where(is_bar | is_lock, jnp.int64(arg),
-                              jnp.where(send_block, jnp.int64(jnp.maximum(arg, 0)),
-                                        jnp.where(blocked, addr, st.pend_addr)))
+        pend_addr = jnp.where(
+            is_bar | is_lock | is_cwait | is_csig | is_cbc, jnp.int64(arg),
+            jnp.where(send_block, jnp.int64(jnp.maximum(arg, 0)),
+                      jnp.where(blocked, addr, st.pend_addr)))
         # Request-issue point: after the local tag checks that discovered
         # the miss (L1 only under shared L2 — there is no private L2 tag
         # array to consult before going to the home slice).
@@ -306,6 +341,12 @@ def local_advance(params: SimParams, state: SimState,
         issue = clk + jnp.where(
             comp_block, l1i_ps + miss_tags_ps,
             jnp.where(mem_rem, l1d_ps + miss_tags_ps, cycle_ps))
+        # Cond waits AND signal/broadcast tokens park with their MCP
+        # arrival time (eligibility compares at the server, SimCond's
+        # timestamps); THREAD_START parks at the local clock.
+        issue = jnp.where(is_cwait | is_csig | is_cbc,
+                          clk + to_mcp_ps, issue)
+        issue = jnp.where(is_tstart, clk, issue)
         pend_issue = jnp.where(blocked, issue, st.pend_issue)
         # For memory requests pend_aux carries the atomic flag (resolve
         # needs it: iocoom lets plain loads/stores complete out-of-order
@@ -384,12 +425,17 @@ def local_advance(params: SimParams, state: SimState,
                               params.net_user.flit_width_bits), 0),
             sends=add(c.sends, is_send),
             barriers=add(c.barriers, is_bar),
+            cond_waits=add(c.cond_waits, is_cwait),
+            cond_signals=add(c.cond_signals, is_csig | is_cbc),
+            spawns=add(c.spawns, is_spawn),
         )
 
         st = st._replace(
             clock=new_clock,
             cursor=st.cursor + jnp.where(active & ~blocked, 1, 0),
             done=st.done | is_done,
+            done_at=jnp.where(is_done, clk, st.done_at),
+            spawned_at=spawned_at,
             pend_kind=pend_kind,
             pend_addr=pend_addr,
             pend_issue=pend_issue,

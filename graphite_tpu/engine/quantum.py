@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from graphite_tpu.engine.core import local_advance
 from graphite_tpu.engine.resolve import resolve
 from graphite_tpu.engine.state import (
-    PEND_BARRIER, PEND_MUTEX, PEND_RECV, PEND_SEND, SimState, TraceArrays)
+    PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_JOIN, PEND_MUTEX,
+    PEND_RECV, PEND_SEND, PEND_START, SimState, TraceArrays)
 from graphite_tpu.params import SimParams
 from graphite_tpu.time_base import TIME_MAX
 
@@ -45,7 +46,12 @@ def next_boundary(params: SimParams, state: SimState) -> jnp.ndarray:
     sync_blocked = ((state.pend_kind == PEND_RECV)
                     | (state.pend_kind == PEND_BARRIER)
                     | (state.pend_kind == PEND_MUTEX)
-                    | (state.pend_kind == PEND_SEND))
+                    | (state.pend_kind == PEND_SEND)
+                    | (state.pend_kind == PEND_COND)
+                    | (state.pend_kind == PEND_CSIG)
+                    | (state.pend_kind == PEND_CBC)
+                    | (state.pend_kind == PEND_JOIN)
+                    | (state.pend_kind == PEND_START))
     runnable = ~state.done & ~sync_blocked
     min_clock = jnp.min(jnp.where(runnable, state.clock, TIME_MAX))
     q = jnp.int64(params.quantum_ps)

@@ -33,9 +33,10 @@ from graphite_tpu.engine import noc_flight
 from graphite_tpu.engine import queue_models
 from graphite_tpu.engine.core import _lat, _period, mcp_tile
 from graphite_tpu.engine.state import (
-    PEND_BARRIER, PEND_EX_REQ, PEND_IFETCH, PEND_MUTEX, PEND_NONE,
-    PEND_RECV, PEND_SEND, PEND_SH_REQ, SimState, dir_meta_lru,
-    dir_meta_owner, dir_meta_state, dir_pack)
+    PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
+    PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
+    PEND_START, SimState, dir_meta_lru, dir_meta_owner, dir_meta_state,
+    dir_pack)
 from graphite_tpu.isa import DVFSModule
 from graphite_tpu.params import SimParams
 
@@ -1190,6 +1191,141 @@ def resolve_mutex(params: SimParams, state: SimState) -> SimState:
     return _unblock(state, win, completion, sync=True)
 
 
+def resolve_cond(params: SimParams, state: SimState) -> SimState:
+    """Match parked cond waiters with parked signal/broadcast tokens.
+
+    Semantics (reference SimCond, sync_server.cc:67-119): the POSTER of a
+    signal/broadcast parks as the token itself (PEND_CSIG/PEND_CBC with
+    its exact MCP-arrival timestamp).  Each pass processes, per cond, the
+    single EARLIEST pending token — so interleaved signals and broadcasts
+    act in exact time order:
+
+      * signal: wakes the earliest waiter with ``park <= t_sig`` (i.e.
+        already parked at the signal's server time, pthread lost-signal
+        semantics); if none exists it stays pending until no still-live
+        tile could park with an earlier timestamp (clock skew within a
+        quantum allows late-arriving earlier parks), then it is LOST.
+      * broadcast: wakes every waiter with ``park <= t_bc``; it is
+        consumed under the same no-earlier-parks-possible rule so skewed
+        waiters are never missed.
+
+    Posters unblock when their token resolves, with timestamp-based
+    completions (MCP ack round trip) — the extra engine passes a pending
+    token waits cost wall time only, never simulated time.  A woken
+    waiter transforms into PEND_MUTEX to re-acquire its mutex through the
+    regular FCFS machinery (SimCond::wait re-locks on wake).
+    """
+    from graphite_tpu.engine.state import NUM_CONDS as NC
+    T = params.num_tiles
+    rows = jnp.arange(T)
+    kind = state.pend_kind
+    is_cw = kind == PEND_COND
+    is_sig = kind == PEND_CSIG
+    is_bc = kind == PEND_CBC
+    is_tok = is_sig | is_bc
+    cid = jnp.clip(state.pend_addr, 0, NC - 1).astype(jnp.int32)
+    t = state.pend_issue                       # MCP-arrival timestamps
+    oh_c = dense.onehot(cid, NC)
+
+    # One earliest token per cond this pass (FCFS by time then tile).
+    tok_win = _elect(is_tok, _fcfs_keys(is_tok, t), cid, NC)
+    tok_time_nc = dense.binmax(oh_c, tok_win, t, 0)          # [NC]
+    tok_bc_nc = dense.binsum(oh_c, tok_win & is_bc, 1) > 0   # [NC]
+    has_tok_nc = dense.binsum(oh_c, tok_win, 1) > 0
+
+    # Waiter eligibility against its cond's elected token.
+    wt = _sel(oh_c, tok_time_nc)
+    w_has = _sel(oh_c, has_tok_nc.astype(jnp.int32)) > 0
+    w_bc = _sel(oh_c, tok_bc_nc.astype(jnp.int32)) > 0
+    elig = is_cw & w_has & (t <= wt)
+    first = _elect(elig, _fcfs_keys(elig, t), cid, NC)
+    wake = jnp.where(w_bc, elig, first)
+
+    p_nu = _period(state, DVFSModule.NETWORK_USER)
+    mcp = mcp_tile(params)
+    to_mcp = noc.unicast_ps(params.net_user, rows,
+                            jnp.full(T, mcp), CTRL_BYTES,
+                            p_nu, params.mesh_width)
+
+    # Token resolution: a signal completes when it woke someone, or when
+    # provably lost; a broadcast completes once no earlier park can still
+    # arrive (its wakes repeat harmlessly until then — same waiters, same
+    # times).  Each tile's future park timestamps are lower-bounded by:
+    # its clock (runnable); STRICTLY past pend_issue when parked (every
+    # resume completes at least a cycle after issue); for mutex waiters,
+    # past issue + to_mcp (the grant can't precede the MCP arrival) —
+    # this matters because cond-woken waiters carry a rewound pend_issue
+    # of (wake - to_mcp) for the re-acquire math, which must not pin the
+    # very token that woke them.  The token excludes ITSELF from the
+    # bound via the two smallest.
+    INF = jnp.int64(2**62)
+    lb = jnp.where(
+        state.done, INF,
+        jnp.where(state.pend_kind == PEND_NONE, state.clock,
+                  jnp.where(state.pend_kind == PEND_MUTEX,
+                            state.pend_issue + to_mcp + 1,
+                            state.pend_issue + 1)))
+    neg2 = jax.lax.top_k(-lb, 2)[0]
+    m1, m2 = -neg2[0], -neg2[1]
+    lb_excl = jnp.where(lb == m1, m2, m1)      # min over the OTHER tiles
+    woke_nc = dense.binsum(oh_c, wake & ~w_bc, 1) > 0
+    woke_mine = _sel(oh_c, woke_nc.astype(jnp.int32)) > 0
+    tok_done = tok_win & ((t < lb_excl) | (is_sig & woke_mine))
+
+    cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
+    from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
+                              CTRL_BYTES, p_nu[mcp], params.mesh_width)
+
+    # Wake waiters: transform into mutex re-acquires; pend_issue is set so
+    # resolve_mutex's (issue + to_mcp) lands exactly at the token time.
+    c = state.counters
+    state = state._replace(
+        pend_kind=jnp.where(wake, PEND_MUTEX, state.pend_kind),
+        pend_addr=jnp.where(wake, state.pend_aux.astype(jnp.int64),
+                            state.pend_addr),
+        pend_issue=jnp.where(wake, wt - to_mcp, state.pend_issue),
+        counters=c._replace(
+            sync_stall_ps=c.sync_stall_ps + jnp.where(wake, wt - t, 0)))
+    # Ack the resolved posters.
+    return _unblock(state, tok_done, t + from_mcp + cycle_ps, sync=True)
+
+
+def resolve_join(params: SimParams, state: SimState) -> SimState:
+    """Release joiners whose child stream has reached DONE (reference:
+    ThreadManager join protocol via the MCP, thread_manager.cc)."""
+    T = params.num_tiles
+    rows = jnp.arange(T)
+    is_j = state.pend_kind == PEND_JOIN
+    child = jnp.clip(state.pend_aux, 0, T - 1)
+    oh_ch = _oh(child, T)
+    child_done = jnp.sum(jnp.where(oh_ch, state.done[None, :], False),
+                         axis=1, dtype=jnp.int32) > 0
+    child_done_at = _sel(oh_ch, state.done_at)
+    ok = is_j & child_done
+    p_nu = _period(state, DVFSModule.NETWORK_USER)
+    cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
+    mcp = mcp_tile(params)
+    to_mcp = noc.unicast_ps(params.net_user, rows, jnp.full(T, mcp),
+                            CTRL_BYTES, p_nu, params.mesh_width)
+    from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
+                              CTRL_BYTES, p_nu[mcp], params.mesh_width)
+    exit_at_mcp = child_done_at + _sel(oh_ch, to_mcp)
+    completion = jnp.maximum(state.pend_issue + to_mcp, exit_at_mcp) \
+        + from_mcp + cycle_ps
+    state = state._replace(counters=state.counters._replace(
+        joins=state.counters.joins + jnp.where(ok, 1, 0)))
+    return _unblock(state, ok, completion, sync=True)
+
+
+def resolve_start(params: SimParams, state: SimState) -> SimState:
+    """Release THREAD_START gates whose tile has been SPAWNed."""
+    is_s = state.pend_kind == PEND_START
+    ok = is_s & (state.spawned_at >= 0)
+    cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
+    completion = jnp.maximum(state.pend_issue, state.spawned_at) + cycle_ps
+    return _unblock(state, ok, completion, sync=True)
+
+
 def _when_pending(kind: int, fn, params: SimParams,
                   state: SimState) -> SimState:
     """Run a resolver only if some tile is parked on its pend kind —
@@ -1202,10 +1338,20 @@ def _when_pending(kind: int, fn, params: SimParams,
 
 
 def resolve(params: SimParams, state: SimState) -> SimState:
-    """One full cross-tile resolution pass."""
+    """One full cross-tile resolution pass.  resolve_cond runs before
+    resolve_mutex so a freshly-woken waiter competes for its mutex
+    re-acquire in the same pass."""
     state = resolve_memory(params, state)
     state = _when_pending(PEND_RECV, resolve_recv, params, state)
     state = _when_pending(PEND_SEND, resolve_send, params, state)
     state = _when_pending(PEND_BARRIER, resolve_barrier, params, state)
+    # Cond resolution runs whenever waiters OR tokens are parked (a lost
+    # signal must still expire and ack its poster with no waiter around).
+    state = jax.lax.cond(
+        ((state.pend_kind == PEND_COND) | (state.pend_kind == PEND_CSIG)
+         | (state.pend_kind == PEND_CBC)).any(),
+        lambda s: resolve_cond(params, s), lambda s: s, state)
     state = _when_pending(PEND_MUTEX, resolve_mutex, params, state)
+    state = _when_pending(PEND_JOIN, resolve_join, params, state)
+    state = _when_pending(PEND_START, resolve_start, params, state)
     return state

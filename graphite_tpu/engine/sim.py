@@ -132,8 +132,13 @@ class SimSummary:
         lines.append("[sync]")
         row("Barriers", agg["barriers"])
         row("Mutex Acquires", agg["mutex_acquires"])
+        row("Cond Waits", agg["cond_waits"])
+        row("Cond Signals/Broadcasts", agg["cond_signals"])
         row("Messages Sent", agg["sends"])
         row("Messages Received", agg["recvs"])
+        lines.append("[threads]")
+        row("Spawns", agg["spawns"])
+        row("Joins", agg["joins"])
         lines.append("[stalls]")
         row("Memory Stall (in ns, total)", f"{ps_to_ns(agg['mem_stall_ps']):.1f}")
         row("Sync Stall (in ns, total)", f"{ps_to_ns(agg['sync_stall_ps']):.1f}")

@@ -39,6 +39,16 @@ PEND_SEND = 7       # user-network send waiting for channel-buffer space
 #   (models the finite receive-side buffering the reference gets from its
 #   per-tile net queues; CAPI sends block in Network::netSend when the
 #   transport back-pressures)
+PEND_COND = 8       # SimCond wait (mutex released; wakes on signal, then
+#   transforms into PEND_MUTEX for the re-acquire)
+PEND_JOIN = 9       # blocked until the named tile's stream is DONE
+PEND_START = 10     # stream gated on being SPAWNed
+PEND_CSIG = 11      # posted signal TOKEN: the signaler parks until its
+#   signal is consumed by a waiter or provably lost — the parked entry IS
+#   the token (exact per-token timestamp, no collapsing), and the
+#   signaler's ack completion is timestamp-based so the extra engine
+#   passes cost no simulated time
+PEND_CBC = 12       # posted broadcast token (same mechanism)
 
 NUM_DVFS_MODULES = len(DVFSModule)
 
@@ -82,6 +92,10 @@ class Counters(NamedTuple):
     recvs: jnp.ndarray
     barriers: jnp.ndarray
     mutex_acquires: jnp.ndarray
+    cond_waits: jnp.ndarray          # COND_WAIT parks
+    cond_signals: jnp.ndarray        # signals + broadcasts posted
+    spawns: jnp.ndarray              # SPAWN events issued by this tile
+    joins: jnp.ndarray               # completed JOINs
     mem_stall_ps: jnp.ndarray        # time blocked on remote memory
     sync_stall_ps: jnp.ndarray       # time blocked on sync/recv
 
@@ -208,11 +222,20 @@ class SimState(NamedTuple):
     # per-link queue models in network_model_emesh_hop_by_hop.cc)
     link_free_mem: jnp.ndarray  # [NUM_DIRS, T] int64 directed-link horizons
 
-    # -- sync objects, global (reference: sync_server.h SimMutex/SimBarrier)
+    # -- sync objects, global (reference: sync_server.h SimMutex/SimBarrier/
+    # SimCond)
     lock_holder: jnp.ndarray   # [NL] int32 holder tile + 1, 0 = free
     lock_free_at: jnp.ndarray  # [NL] int64 time the lock was/will be released
     bar_count: jnp.ndarray     # [NB] int32 arrivals this generation
     bar_time: jnp.ndarray      # [NB] int64 max arrival time this generation
+    # (cond-var signal/broadcast tokens live as parked PEND_CSIG/PEND_CBC
+    # entries — pend_addr = cond id, pend_issue = MCP arrival — so no
+    # dedicated arrays are needed and every token keeps its exact time)
+
+    # -- thread lifecycle (reference: thread_manager.cc spawn/join tables)
+    spawned_at: jnp.ndarray    # [T] int64 when this tile's stream was
+    #   spawned (-1 = not yet; THREAD_START gates on it)
+    done_at: jnp.ndarray       # [T] int64 when the tile's DONE retired
 
     # -- user-network channels (CAPI; reference: common/user/capi.cc)
     ch_sent: jnp.ndarray       # [T, T] int32 messages sent src->dst
@@ -239,6 +262,9 @@ def _dummy_cache(num_tiles: int) -> cachemod.CacheArrays:
     return cachemod.CacheArrays(
         tags=z, meta=cachemod.pack_meta(z, z),
         rr_ptr=jnp.zeros((num_tiles, 1), dtype=jnp.int32))
+
+
+NUM_CONDS = 64      # cond-var id space (like max_mutexes; ids clip)
 
 
 def make_state(params: SimParams,
@@ -290,6 +316,8 @@ def make_state(params: SimParams,
         lock_free_at=jnp.zeros(max_mutexes, dtype=jnp.int64),
         bar_count=jnp.zeros(max_barriers, dtype=jnp.int32),
         bar_time=jnp.zeros(max_barriers, dtype=jnp.int64),
+        spawned_at=jnp.full(T, -1, dtype=jnp.int64),
+        done_at=jnp.zeros(T, dtype=jnp.int64),
         ch_sent=jnp.zeros((T, T), dtype=jnp.int32),
         ch_recvd=jnp.zeros((T, T), dtype=jnp.int32),
         ch_time=jnp.zeros((channel_depth, T, T), dtype=jnp.int64),

@@ -26,6 +26,11 @@ RECV             -                      size (bytes)    source tile
 BARRIER_WAIT     -                      barrier id      participant count
 MUTEX_LOCK       -                      mutex id        0
 MUTEX_UNLOCK     -                      mutex id        0
+COND_WAIT        -                      cond id         mutex id (held)
+COND_SIGNAL      -                      cond id         0
+COND_BROADCAST   -                      cond id         0
+JOIN             -                      -               child tile
+THREAD_START     -                      -               -
 SYNC             wake time (ps)         cost (cycles)   0
 SPAWN            -                      cost (cycles)   child tile
 STALL            until time (ps)        -               0
@@ -213,6 +218,29 @@ class TraceBuilder:
 
     def mutex_unlock(self, tile: int, mutex_id: int) -> None:
         self._emit(tile, EventOp.MUTEX_UNLOCK, 0, mutex_id, 0)
+
+    def cond_wait(self, tile: int, cond_id: int, mutex_id: int) -> None:
+        """Release ``mutex_id`` (which the tile must hold), park until a
+        signal, then re-acquire it before continuing."""
+        self._emit(tile, EventOp.COND_WAIT, 0, cond_id, mutex_id)
+
+    def cond_signal(self, tile: int, cond_id: int) -> None:
+        self._emit(tile, EventOp.COND_SIGNAL, 0, cond_id, 0)
+
+    def cond_broadcast(self, tile: int, cond_id: int) -> None:
+        self._emit(tile, EventOp.COND_BROADCAST, 0, cond_id, 0)
+
+    def spawn(self, tile: int, child: int, cost_cycles: int = 0) -> None:
+        """Start ``child``'s stream (which must begin with THREAD_START)."""
+        self._emit(tile, EventOp.SPAWN, 0, cost_cycles, child)
+
+    def join(self, tile: int, child: int) -> None:
+        """Block until ``child``'s stream reaches DONE."""
+        self._emit(tile, EventOp.JOIN, 0, 0, child)
+
+    def thread_start(self, tile: int) -> None:
+        """Gate this tile's stream on being SPAWNed by another tile."""
+        self._emit(tile, EventOp.THREAD_START, 0, 0, 0)
 
     def stall_until(self, tile: int, time_ps: int) -> None:
         self._emit(tile, EventOp.STALL, time_ps, 0, 0)

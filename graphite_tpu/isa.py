@@ -75,6 +75,14 @@ class EventOp(enum.IntEnum):
                        # reference: common/system/sync_server.h:15-121)
     MUTEX_LOCK = 14    # FCFS simulated mutex acquire (SimMutex analog)
     MUTEX_UNLOCK = 15  # release; wakes earliest waiter
+    COND_WAIT = 16     # release held mutex + park until signaled, then
+                       # re-acquire (SimCond::wait, sync_server.cc:67-74)
+    COND_SIGNAL = 17   # wake earliest waiter parked at signal time; lost
+                       # if none (SimCond::signal, sync_server.cc:76-100)
+    COND_BROADCAST = 18  # wake every waiter parked at broadcast time
+    JOIN = 19          # block until the named tile's stream is DONE
+                       # (ThreadManager join protocol, thread_manager.cc)
+    THREAD_START = 20  # block the stream until some tile SPAWNs this one
 
 
 class MemComponent(enum.IntEnum):

@@ -1,0 +1,264 @@
+"""Condition variables + thread lifecycle tests.
+
+Pin the SimCond contract (reference: common/system/sync_server.cc:67-119)
+— signal wakes only waiters already parked at the signal's server time
+(lost otherwise), broadcast wakes all such waiters, woken waiters
+re-acquire their mutex through FCFS — and the spawn/join lifecycle
+(reference: common/system/thread_manager.cc): THREAD_START gates a
+stream until SPAWNed, JOIN blocks until the child's DONE.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import DeadlockError, Simulator, run_simulation
+from graphite_tpu.events.schema import TraceBuilder
+from graphite_tpu.params import SimParams
+
+
+def make_params(tiles=4, **over):
+    cfg = load_config()
+    cfg.set("general/total_cores", tiles)
+    for k, v in over.items():
+        cfg.set(k, v)
+    return SimParams.from_config(cfg)
+
+
+def counters_np(s):
+    return {k: v for k, v in s.counters.items()}
+
+
+def test_producer_consumer_wakeup_timing():
+    """Consumer parks long before the producer signals: its wakeup cannot
+    precede the signal's posting time (golden lower bound), and it must
+    re-acquire the mutex the producer held."""
+    params = make_params(4)
+    sig_at = 8_000_000            # producer signals around t = 8 us
+    tb = TraceBuilder(4)
+    # consumer (tile 0): lock, wait (releases lock), then unlock
+    tb.mutex_lock(0, 0)
+    tb.cond_wait(0, 0, 0)
+    tb.mutex_unlock(0, 0)
+    # producer (tile 1): much later, lock, signal, unlock
+    tb.stall_until(1, sig_at)
+    tb.mutex_lock(1, 0)
+    tb.cond_signal(1, 0)
+    tb.mutex_unlock(1, 0)
+    trace = tb.build()
+    s = run_simulation(params, trace)
+    assert s.to_dict()["all_done"]
+    c = counters_np(s)
+    assert int(c["cond_waits"].sum()) == 1
+    assert int(c["cond_signals"].sum()) == 1
+    # consumer finished after the signal was posted
+    assert int(s.clock[0]) >= sig_at
+    # consumer's initial lock + its post-wake RE-ACQUIRE + producer's lock
+    assert int(c["mutex_acquires"].sum()) == 3
+    assert int(c["mutex_acquires"][0]) == 2
+
+
+def test_signal_before_wait_is_lost():
+    """A signal posted with no waiter parked is dropped (pthread / SimCond
+    semantics): a consumer that parks later deadlocks."""
+    params = make_params(2)
+    tb = TraceBuilder(2)
+    tb.cond_signal(1, 0)                 # early signal, nobody waiting
+    tb.stall_until(0, 50_000_000)        # park long after it
+    tb.mutex_lock(0, 0)
+    tb.cond_wait(0, 0, 0)
+    tb.mutex_unlock(0, 0)
+    trace = tb.build()
+    sim = Simulator(params, trace)
+    with pytest.raises(DeadlockError):
+        sim.run()
+
+
+def test_broadcast_wakes_all_waiters():
+    params = make_params(4)
+    tb = TraceBuilder(4)
+    for t in range(3):                   # three waiters on distinct mutexes
+        tb.mutex_lock(t, t)
+        tb.cond_wait(t, 0, t)
+        tb.mutex_unlock(t, t)
+    tb.stall_until(3, 10_000_000)
+    tb.cond_broadcast(3, 0)
+    trace = tb.build()
+    s = run_simulation(params, trace)
+    assert s.to_dict()["all_done"]
+    # all three waiters resumed after the broadcast
+    assert all(int(s.clock[t]) >= 10_000_000 for t in range(3))
+
+
+def test_signal_wakes_exactly_one():
+    """Two waiters, one signal: exactly one wakes; the second needs the
+    second signal."""
+    params = make_params(4)
+    tb = TraceBuilder(4)
+    for t in (0, 1):
+        tb.mutex_lock(t, t)
+        tb.cond_wait(t, 0, t)
+        tb.mutex_unlock(t, t)
+    tb.stall_until(2, 10_000_000)
+    tb.cond_signal(2, 0)
+    tb.stall_until(3, 30_000_000)
+    tb.cond_signal(3, 0)
+    trace = tb.build()
+    s = run_simulation(params, trace)
+    assert s.to_dict()["all_done"]
+    ends = sorted(int(s.clock[t]) for t in (0, 1))
+    # FCFS: earliest waiter (tile 0) took the first signal
+    assert ends[0] >= 10_000_000 and ends[0] < 30_000_000
+    assert ends[1] >= 30_000_000
+
+
+def test_spawn_gates_thread_start():
+    """A THREAD_START-gated stream runs only after its SPAWN lands; the
+    child's clock begins at the spawn time, not zero."""
+    params = make_params(2)
+    spawn_at = 5_000_000
+    tb = TraceBuilder(2)
+    tb.thread_start(1)
+    tb.compute(1, 100, 10)
+    tb.stall_until(0, spawn_at)
+    tb.spawn(0, 1, cost_cycles=200)
+    trace = tb.build()
+    s = run_simulation(params, trace)
+    assert s.to_dict()["all_done"]
+    assert int(s.clock[1]) > spawn_at
+    assert int(counters_np(s)["spawns"].sum()) == 1
+
+
+def test_join_blocks_until_child_done():
+    params = make_params(2)
+    child_busy_until = 20_000_000
+    tb = TraceBuilder(2)
+    tb.thread_start(1)
+    tb.stall_until(1, child_busy_until)
+    tb.done(1)
+    tb.spawn(0, 1)
+    tb.join(0, 1)
+    trace = tb.build()
+    s = run_simulation(params, trace)
+    assert s.to_dict()["all_done"]
+    assert int(s.clock[0]) >= child_busy_until
+    assert int(counters_np(s)["joins"].sum()) == 1
+
+
+def test_unspawned_thread_deadlocks():
+    params = make_params(2)
+    tb = TraceBuilder(2)
+    tb.thread_start(1)          # nobody ever spawns tile 1
+    tb.compute(0, 10, 1)
+    trace = tb.build()
+    sim = Simulator(params, trace)
+    with pytest.raises(DeadlockError):
+        sim.run()
+
+
+def test_broadcast_then_signal_interleave():
+    """Broadcast at t1 wakes the waiters parked before it; a LATER-parked
+    waiter is untouched by the broadcast and needs the later signal —
+    tokens act in exact time order (SimCond processes server-ordered)."""
+    params = make_params(5)
+    tb = TraceBuilder(5)
+    for t in (0, 1):                       # parked before the broadcast
+        tb.mutex_lock(t, t)
+        tb.cond_wait(t, 0, t)
+        tb.mutex_unlock(t, t)
+    tb.stall_until(2, 30_000_000)          # parks AFTER the broadcast
+    tb.mutex_lock(2, 2)
+    tb.cond_wait(2, 0, 2)
+    tb.mutex_unlock(2, 2)
+    tb.stall_until(3, 20_000_000)
+    tb.cond_broadcast(3, 0)                # t ~ 20ms: wakes 0 and 1 only
+    tb.stall_until(4, 40_000_000)
+    tb.cond_signal(4, 0)                   # t ~ 40ms: wakes 2
+    trace = tb.build()
+    s = run_simulation(params, trace)
+    assert s.to_dict()["all_done"]
+    assert int(s.clock[0]) < 40_000_000    # woken by the broadcast...
+    assert int(s.clock[1]) < 40_000_000
+    assert int(s.clock[2]) >= 40_000_000   # ...but 2 needed the signal
+
+
+def test_early_signal_lost_later_signal_wakes():
+    """Review counterexample: signal@early (nobody parked) must be LOST;
+    waiters park later; signal@late wakes exactly the earliest waiter —
+    the early token must not linger and wake the second waiter."""
+    params = make_params(4)
+    tb = TraceBuilder(4)
+    tb.cond_signal(3, 0)                   # t ~ 0: lost (nobody parked)
+    tb.done(3)
+    tb.stall_until(0, 10_000_000)
+    tb.mutex_lock(0, 0)
+    tb.cond_wait(0, 0, 0)
+    tb.mutex_unlock(0, 0)
+    tb.done(0)
+    tb.stall_until(1, 12_000_000)
+    tb.mutex_lock(1, 1)
+    tb.cond_wait(1, 0, 1)
+    tb.mutex_unlock(1, 1)
+    tb.done(1)
+    tb.stall_until(2, 30_000_000)
+    tb.cond_signal(2, 0)                   # wakes tile 0 only
+    tb.done(2)
+    trace = tb.build()
+    sim = Simulator(params, trace)
+    # tile 1 waits forever: the early signal is lost, tile 0 takes the
+    # late one
+    import pytest as _pytest
+    with _pytest.raises(DeadlockError):
+        sim.run()
+    s = sim.summary()
+    assert bool(s.done[0])                 # tile 0 woke and finished
+    assert not bool(s.done[1])             # tile 1 correctly stuck
+    assert bool(s.done[2]) and bool(s.done[3])
+
+
+def test_fork_join_pool_broadcast_while_holding_mutex():
+    """Regression: the broadcaster still HOLDS the mutex its waiters will
+    re-acquire (lock; broadcast; unlock — the canonical pattern).  The
+    broadcast ack must not wait on the woken waiters' rewound mutex parks
+    (that cycle deadlocked an earlier token-expiry rule)."""
+    params = make_params(4)
+    tb = TraceBuilder(4)
+    for w in (1, 2):
+        tb.thread_start(w)
+        tb.mutex_lock(w, 0)
+        tb.cond_wait(w, 0, 0)
+        tb.mutex_unlock(w, 0)
+        tb.compute(w, 500, 100)
+        tb.done(w)
+    tb.spawn(0, 1)
+    tb.spawn(0, 2)
+    tb.stall_until(0, 10_000_000)
+    tb.mutex_lock(0, 0)
+    tb.cond_broadcast(0, 0)
+    tb.mutex_unlock(0, 0)
+    tb.join(0, 1)
+    tb.join(0, 2)
+    trace = tb.build()
+    s = run_simulation(params, trace)
+    assert s.to_dict()["all_done"]
+    c = counters_np(s)
+    assert int(c["joins"].sum()) == 2
+    # workers' initial locks + their re-acquires + the broadcaster's lock
+    assert int(c["mutex_acquires"].sum()) == 5
+
+
+def test_cond_lifecycle_deterministic():
+    params = make_params(4)
+    tb = TraceBuilder(4)
+    for t in (0, 1):
+        tb.mutex_lock(t, 0)
+        tb.cond_wait(t, 0, 0)
+        tb.mutex_unlock(t, 0)
+    tb.stall_until(2, 10_000_000)
+    tb.cond_broadcast(2, 0)
+    trace = tb.build()
+    s1 = run_simulation(params, trace)
+    s2 = run_simulation(params, trace)
+    assert s1.completion_time_ps == s2.completion_time_ps
+    for k, v in counters_np(s1).items():
+        assert np.array_equal(v, counters_np(s2)[k]), k
