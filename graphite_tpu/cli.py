@@ -40,6 +40,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     overrides, rest = parse_overrides(argv)
     args = _build_parser().parse_args(rest)
     cfg = load_config(args.config, overrides=overrides)
+    from graphite_tpu import log as logmod
+    logmod.configure(cfg)
 
     if args.command == "params":
         params = SimParams.from_config(cfg)

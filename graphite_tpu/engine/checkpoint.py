@@ -24,7 +24,8 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 8  # v4: packed int32 cache/dir metadata layout;
+_SCHEMA_VERSION = 9  # v4: packed int32 cache/dir metadata layout;
+#   v9: ROI flag + statistics/progress sample ring;
 #   v5: iocoom load/store queue state (lq/sq rings);
 #   v6: dir_forwards counter (MOSI cache-to-cache transfers);
 #   v7: link_free_mem horizons + net_link_wait_ps (NoC contention);

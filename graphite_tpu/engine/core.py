@@ -84,6 +84,21 @@ def local_advance(params: SimParams, state: SimState,
         arg = ev[1]
         arg2 = ev[2]
 
+        # Region of interest: outside it, compute/branch/memory events
+        # fast-forward — zero cost, no cache effects, no counters (the
+        # reference's disabled-models mode runs functionally without
+        # instrumentation, simulator.cc:287-301).  Sync, network, and
+        # lifecycle events stay functional either way.
+        en = st.models_enabled
+        if params.enable_core_modeling:
+            models_enabled = (st.models_enabled
+                              | (op == EventOp.ENABLE_MODELS).any()) \
+                & ~(op == EventOp.DISABLE_MODELS).any()
+        else:
+            # Core modeling disabled in config: ROI markers in the trace
+            # cannot re-enable it.
+            models_enabled = st.models_enabled
+
         # iocoom drain points: atomics, sync/thread ops, DONE (and branches
         # unless speculative loads are on) wait for every outstanding
         # load/store completion (reference: iocoom_core_model.cc LQ/SQ
@@ -148,11 +163,11 @@ def local_advance(params: SimParams, state: SimState,
         fetch_ps = icount_ev * l1i_ps
         if shared_l2:
             comp_l2path = jnp.zeros_like(is_comp)
-            comp_block = is_comp & ~pI.hit
+            comp_block = is_comp & ~pI.hit & en
             dt_comp = cost_ps + fetch_ps
         else:
-            comp_l2path = is_comp & ~pI.hit & pL2.hit
-            comp_block = is_comp & ~pI.hit & ~pL2.hit
+            comp_l2path = is_comp & ~pI.hit & pL2.hit & en
+            comp_block = is_comp & ~pI.hit & ~pL2.hit & en
             dt_comp = cost_ps + fetch_ps \
                 + jnp.where(~pI.hit, n_lines * l2_ps, 0)
         comp_ok = is_comp & ~comp_block
@@ -174,7 +189,8 @@ def local_advance(params: SimParams, state: SimState,
             dt_br = jnp.where(
                 correct, cycle_ps,
                 _lat(params.core.bp_mispredict_penalty, p_core)) + l1i_ps
-            bp_sel = is_br[:, None] & dense.onehot(bidx, params.core.bp_size)
+            bp_sel = (is_br & en)[:, None] \
+                & dense.onehot(bidx, params.core.bp_size)
             bp_table = jnp.where(bp_sel, taken[:, None], st.bp_table)
 
         # ------------------------------------------------- MEMORY OPERANDS
@@ -189,14 +205,14 @@ def local_advance(params: SimParams, state: SimState,
         mesi_local = params.protocol_kind == "sh_l2_mesi"
         writable = pD.state >= (E if mesi_local else M)
         l1_ok = pD.hit & (is_rd | writable)
-        mem_l1 = is_mem & l1_ok
+        mem_l1 = is_mem & l1_ok & en
         if shared_l2:
             mem_l2 = jnp.zeros_like(mem_l1)
-            mem_rem = is_mem & ~l1_ok
+            mem_rem = is_mem & ~l1_ok & en
         else:
             l2_ok = pL2.hit & (is_rd | (pL2.state == M))
-            mem_l2 = is_mem & ~l1_ok & l2_ok
-            mem_rem = is_mem & ~l1_ok & ~l2_ok
+            mem_l2 = is_mem & ~l1_ok & l2_ok & en
+            mem_rem = is_mem & ~l1_ok & ~l2_ok & en
         at_extra = jnp.where(is_at, cycle_ps, 0)
         dt_mem_l1 = l1d_ps + at_extra
         dt_mem_l2 = l1d_ps + l2_ps + at_extra
@@ -296,8 +312,8 @@ def local_advance(params: SimParams, state: SimState,
 
         # ------------------------------------------------------ combine dt
         dt = jnp.zeros(T, dtype=jnp.int64)
-        dt = jnp.where(comp_ok, dt_comp, dt)
-        dt = jnp.where(is_br, dt_br, dt)
+        dt = jnp.where(comp_ok & en, dt_comp, dt)
+        dt = jnp.where(is_br & en, dt_br, dt)
         dt = jnp.where(mem_l1, dt_mem_l1, dt)
         dt = jnp.where(mem_l2, dt_mem_l2, dt)
         dt = jnp.where(is_send, dt_send, dt)
@@ -368,7 +384,8 @@ def local_advance(params: SimParams, state: SimState,
         pend_extra = jnp.where(blocked, extra, st.pend_extra)
 
         # ------------------------------------------------- cache updates
-        l1i = cachemod.touch(st.l1i, pI.set_idx, pI.way, is_comp & pI.hit)
+        l1i = cachemod.touch(st.l1i, pI.set_idx, pI.way,
+                             is_comp & pI.hit & en)
         if shared_l2:
             l2 = st.l2
             l1d = cachemod.touch(st.l1d, pD.set_idx, pD.way, mem_l1)
@@ -395,16 +412,17 @@ def local_advance(params: SimParams, state: SimState,
             l1d = fD.cache
 
         # ------------------------------------------------------- counters
+        # (all gated on the ROI flag: outside it nothing accumulates)
         def add(x, mask, val=1):
-            return x + jnp.where(mask, jnp.int64(val), 0)
+            return x + jnp.where(mask & en, jnp.int64(val), 0)
 
         c = c._replace(
             icount=c.icount
-            + jnp.where(is_comp, icount_ev, 0)
-            + jnp.where((is_mem & (arg2 == 0)) | is_br, 1, 0),
-            l1i_access=c.l1i_access + jnp.where(is_comp, icount_ev, 0)
-            + jnp.where(is_br, 1, 0),
-            l1i_miss=c.l1i_miss + jnp.where(is_comp & ~pI.hit & active,
+            + jnp.where(is_comp & en, icount_ev, 0)
+            + jnp.where(((is_mem & (arg2 == 0)) | is_br) & en, 1, 0),
+            l1i_access=c.l1i_access + jnp.where(is_comp & en, icount_ev, 0)
+            + jnp.where(is_br & en, 1, 0),
+            l1i_miss=c.l1i_miss + jnp.where(is_comp & ~pI.hit & active & en,
                                             n_lines, 0),
             l1d_read=add(c.l1d_read, is_rd),
             l1d_read_miss=add(c.l1d_read_miss, is_rd & ~l1_ok),
@@ -420,7 +438,7 @@ def local_advance(params: SimParams, state: SimState,
             mispredicts=add(c.mispredicts, is_br & ~correct),
             net_user_pkts=add(c.net_user_pkts, is_send),
             net_user_flits=c.net_user_flits + jnp.where(
-                is_send,
+                is_send & en,
                 noc.num_flits(jnp.maximum(arg, 0),
                               params.net_user.flit_width_bits), 0),
             sends=add(c.sends, is_send),
@@ -436,6 +454,7 @@ def local_advance(params: SimParams, state: SimState,
             done=st.done | is_done,
             done_at=jnp.where(is_done, clk, st.done_at),
             spawned_at=spawned_at,
+            models_enabled=models_enabled,
             pend_kind=pend_kind,
             pend_addr=pend_addr,
             pend_issue=pend_issue,

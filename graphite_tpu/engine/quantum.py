@@ -60,6 +60,49 @@ def next_boundary(params: SimParams, state: SimState) -> jnp.ndarray:
                      state.boundary + q).astype(jnp.int64)
 
 
+def _maybe_sample(params: SimParams, state: SimState) -> SimState:
+    """Record one statistics/progress sample when the quantum boundary
+    crosses the sampling interval (the reference samples on barrier
+    releases the same way — lax_barrier_sync_server.cc:157-159 notifying
+    statistics_thread.cc; series list per statistics_manager.cc:41-114)."""
+    from graphite_tpu.engine import cache as cachemod
+    from graphite_tpu.engine.state import dir_meta_state
+    S = state.stat_time.shape[0]
+    interval = jnp.int64(params.stat_interval_ps)
+    do = (state.boundary >= state.stat_next) & (state.stat_filled < S)
+
+    def take(st: SimState) -> SimState:
+        idx = jnp.minimum(st.stat_filled, S - 1)
+        c = st.counters
+        if params.shared_l2:
+            live = jnp.sum(dir_meta_state(st.dir_meta) != 0,
+                           dtype=jnp.int64)
+        else:
+            live = jnp.sum(cachemod.meta_state(st.l2.meta) != 0,
+                           dtype=jnp.int64)
+        # cache_line_replication analog: total tracked sharer bits
+        repl = jnp.sum(jnp.bitwise_count(st.dir_sharers),
+                       dtype=jnp.int64)
+        scalars = jnp.stack([
+            jnp.sum(c.icount), jnp.sum(c.net_mem_flits),
+            jnp.sum(c.net_user_flits), jnp.sum(c.dram_reads),
+            jnp.sum(c.dram_writes), live, repl,
+            jnp.sum(c.net_link_wait_ps)])
+        st = st._replace(
+            stat_time=st.stat_time.at[idx].set(st.boundary),
+            stat_scalars=st.stat_scalars.at[:, idx].set(scalars),
+            stat_filled=st.stat_filled + 1,
+            stat_next=(st.boundary // interval + 1) * interval)
+        if params.progress_enabled:
+            st = st._replace(
+                stat_icount=st.stat_icount.at[idx].set(c.icount))
+        return st
+
+    # lax.cond skips the metadata scans entirely on non-sampling quanta
+    # (most of them, at typical interval >> quantum ratios).
+    return jax.lax.cond(do, take, lambda st: st, state)
+
+
 def quantum_step(params: SimParams, state: SimState,
                  trace: TraceArrays) -> SimState:
     """One barrier quantum: all tiles advance to the new boundary."""
@@ -70,7 +113,11 @@ def quantum_step(params: SimParams, state: SimState,
         st = resolve(params, st)
         return st
 
-    return jax.lax.fori_loop(0, params.rounds_per_quantum, sub_round, state)
+    state = jax.lax.fori_loop(0, params.rounds_per_quantum, sub_round,
+                              state)
+    if params.stats_enabled or params.progress_enabled:
+        state = _maybe_sample(params, state)
+    return state
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=1)

@@ -1099,7 +1099,8 @@ def resolve_recv(params: SimParams, state: SimState) -> SimState:
         ch_time=state.ch_time.at[slot, src_eff, rows].set(
             completion, mode="drop"),
         counters=state.counters._replace(
-            recvs=state.counters.recvs + jnp.where(ok, 1, 0)))
+            recvs=state.counters.recvs + jnp.where(
+                ok & state.models_enabled, 1, 0)))
     return _unblock(state, ok, completion, sync=True)
 
 
@@ -1128,11 +1129,14 @@ def resolve_send(params: SimParams, state: SimState) -> SimState:
         ch_time=state.ch_time.at[slot, src_eff, dst].set(arrival, mode="drop"),
         ch_sent=state.ch_sent.at[src_eff, dst].add(1, mode="drop"),
         counters=state.counters._replace(
-            sends=state.counters.sends + jnp.where(ok, 1, 0),
-            net_user_pkts=state.counters.net_user_pkts + jnp.where(ok, 1, 0),
+            sends=state.counters.sends + jnp.where(
+                ok & state.models_enabled, 1, 0),
+            net_user_pkts=state.counters.net_user_pkts + jnp.where(
+                ok & state.models_enabled, 1, 0),
             net_user_flits=state.counters.net_user_flits + jnp.where(
-                ok, noc.num_flits(state.pend_addr,
-                                  params.net_user.flit_width_bits), 0)))
+                ok & state.models_enabled,
+                noc.num_flits(state.pend_addr,
+                              params.net_user.flit_width_bits), 0)))
     return _unblock(state, ok, completion, sync=True)
 
 
@@ -1187,7 +1191,7 @@ def resolve_mutex(params: SimParams, state: SimState) -> SimState:
             (rows + 1).astype(jnp.int32), mode="drop"),
         counters=state.counters._replace(
             mutex_acquires=state.counters.mutex_acquires
-            + jnp.where(win, 1, 0)))
+            + jnp.where(win & state.models_enabled, 1, 0)))
     return _unblock(state, win, completion, sync=True)
 
 
@@ -1285,7 +1289,11 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
                             state.pend_addr),
         pend_issue=jnp.where(wake, wt - to_mcp, state.pend_issue),
         counters=c._replace(
-            sync_stall_ps=c.sync_stall_ps + jnp.where(wake, wt - t, 0)))
+            # Stall charged here covers [park, handoff-to-mutex); the
+            # mutex _unblock then adds [wt - to_mcp, completion) — the
+            # to_mcp subtraction avoids double-counting that overlap.
+            sync_stall_ps=c.sync_stall_ps + jnp.where(
+                wake, jnp.maximum(wt - to_mcp - t, 0), 0)))
     # Ack the resolved posters.
     return _unblock(state, tok_done, t + from_mcp + cycle_ps, sync=True)
 
@@ -1313,7 +1321,8 @@ def resolve_join(params: SimParams, state: SimState) -> SimState:
     completion = jnp.maximum(state.pend_issue + to_mcp, exit_at_mcp) \
         + from_mcp + cycle_ps
     state = state._replace(counters=state.counters._replace(
-        joins=state.counters.joins + jnp.where(ok, 1, 0)))
+        joins=state.counters.joins + jnp.where(
+            ok & state.models_enabled, 1, 0)))
     return _unblock(state, ok, completion, sync=True)
 
 

@@ -38,6 +38,10 @@ class SimSummary:
         self.clock = np.asarray(state.clock)
         self.done = np.asarray(state.done)
         self.period_ps = np.asarray(state.period_ps)
+        self.stat_filled = int(state.stat_filled)
+        self.stat_time = np.asarray(state.stat_time)
+        self.stat_scalars = np.asarray(state.stat_scalars)
+        self.stat_icount = np.asarray(state.stat_icount)
         self.counters: Dict[str, np.ndarray] = {
             f: np.asarray(getattr(state.counters, f))
             for f in state.counters._fields
@@ -58,6 +62,48 @@ class SimSummary:
         if self.host_seconds <= 0:
             return float("inf")
         return self.total_instructions / self.host_seconds / 1e6
+
+    STAT_SERIES = ("icount", "net_mem_flits", "net_user_flits",
+                   "dram_reads", "dram_writes", "live_l2_lines",
+                   "sharer_copies", "net_link_wait_ps")
+
+    def stats_trace(self) -> Dict[str, np.ndarray]:
+        """Periodic samples taken at quantum boundaries (the reference's
+        StatisticsManager trace files + progress trace, as arrays).
+        Cumulative series; differentiate for rates."""
+        n = self.stat_filled
+        out = {"time_ps": self.stat_time[:n]}
+        for i, name in enumerate(self.STAT_SERIES):
+            out[name] = self.stat_scalars[i, :n]
+        if self.params.progress_enabled:
+            out["tile_icount"] = self.stat_icount[:n]
+        return out
+
+    def write_stats_csv(self, path: str) -> None:
+        """Statistics-trace file (reference: statistics_manager.cc trace
+        file output, one row per sample)."""
+        tr = self.stats_trace()
+        cols = [k for k in tr if k != "tile_icount"]
+        with open(path, "w") as f:
+            f.write(",".join(cols) + "\n")
+            for i in range(len(tr["time_ps"])):
+                f.write(",".join(str(int(tr[c][i])) for c in cols) + "\n")
+
+    def write_progress_trace(self, path: str) -> None:
+        """Per-tile progress CSV (reference: pin/progress_trace.cc —
+        (time, instruction count) rows per tile)."""
+        if not self.params.progress_enabled:
+            raise ValueError(
+                "progress trace was not recorded: set "
+                "progress_trace/enabled=true before the run")
+        tr = self.stats_trace()
+        with open(path, "w") as f:
+            f.write("time_ps," + ",".join(
+                f"tile{t}" for t in range(self.params.num_tiles)) + "\n")
+            for i in range(len(tr["time_ps"])):
+                row = tr["tile_icount"][i]
+                f.write(str(int(tr["time_ps"][i])) + ","
+                        + ",".join(str(int(v)) for v in row) + "\n")
 
     def energy(self):
         """Analytic McPAT/DSENT-shaped energy breakdown (graphite_tpu.
@@ -181,6 +227,11 @@ class Simulator:
     def run(self, max_steps: Optional[int] = None,
             poll_every: int = 8) -> SimSummary:
         """Run megasteps until every tile is DONE (or max_steps)."""
+        from graphite_tpu.log import get_logger
+        lg = get_logger("driver")
+        lg.info("run: %d tiles, %d events/tile, protocol=%s",
+                self.params.num_tiles, self.trace.num_events,
+                self.params.protocol)
         t0 = time.perf_counter()
         last_progress = None
         while True:
@@ -203,6 +254,8 @@ class Simulator:
                     f"(cursor_sum={cursor_sum}, clock_sum={clock_sum})")
             last_progress = progress
         self.host_seconds = time.perf_counter() - t0
+        lg.info("run finished: %d megasteps, %.2f host-s", self.steps,
+                self.host_seconds)
         return self.summary()
 
     def summary(self) -> SimSummary:

@@ -237,6 +237,23 @@ class SimState(NamedTuple):
     #   spawned (-1 = not yet; THREAD_START gates on it)
     done_at: jnp.ndarray       # [T] int64 when the tile's DONE retired
 
+    # -- region of interest (reference: Simulator::enableModels +
+    # PerformanceCounterManager broadcast) — one global flag; outside the
+    # ROI compute/memory events fast-forward uncosted and uncounted
+    models_enabled: jnp.ndarray   # [] bool
+
+    # -- periodic sampling ring (reference: StatisticsManager's barrier-
+    # clocked sampling + progress trace); fixed capacity, sampled at
+    # quantum boundaries crossing the configured interval
+    stat_filled: jnp.ndarray      # [] int32 samples taken
+    stat_next: jnp.ndarray        # [] int64 next sample time
+    stat_time: jnp.ndarray        # [S] int64 sample timestamps
+    stat_scalars: jnp.ndarray     # [8, S] int64 aggregate series:
+    #   (icount, net_mem_flits, net_user_flits, dram_reads, dram_writes,
+    #    live_l2_or_slice_lines, sharer_bits [replication], link_wait_ps)
+    stat_icount: jnp.ndarray      # [S, T] int64 per-tile icount snapshots
+    #   (the progress trace; [1, T] dummy when disabled)
+
     # -- user-network channels (CAPI; reference: common/user/capi.cc)
     ch_sent: jnp.ndarray       # [T, T] int32 messages sent src->dst
     ch_recvd: jnp.ndarray      # [T, T] int32 messages consumed
@@ -265,6 +282,12 @@ def _dummy_cache(num_tiles: int) -> cachemod.CacheArrays:
 
 
 NUM_CONDS = 64      # cond-var id space (like max_mutexes; ids clip)
+
+
+def _nsamp(params: SimParams) -> int:
+    """Sample-ring capacity: 1-row dummy when no sampling is configured."""
+    return params.max_stat_samples \
+        if (params.stats_enabled or params.progress_enabled) else 1
 
 
 def make_state(params: SimParams,
@@ -318,6 +341,14 @@ def make_state(params: SimParams,
         bar_time=jnp.zeros(max_barriers, dtype=jnp.int64),
         spawned_at=jnp.full(T, -1, dtype=jnp.int64),
         done_at=jnp.zeros(T, dtype=jnp.int64),
+        models_enabled=jnp.asarray(params.models_enabled_at_start),
+        stat_filled=jnp.int32(0),
+        stat_next=jnp.asarray(params.stat_interval_ps, dtype=jnp.int64),
+        stat_time=jnp.zeros(_nsamp(params), dtype=jnp.int64),
+        stat_scalars=jnp.zeros((8, _nsamp(params)), dtype=jnp.int64),
+        stat_icount=jnp.zeros(
+            (_nsamp(params) if params.progress_enabled else 1, T),
+            dtype=jnp.int64),
         ch_sent=jnp.zeros((T, T), dtype=jnp.int32),
         ch_recvd=jnp.zeros((T, T), dtype=jnp.int32),
         ch_time=jnp.zeros((channel_depth, T, T), dtype=jnp.int64),

@@ -242,6 +242,16 @@ class TraceBuilder:
         """Gate this tile's stream on being SPAWNed by another tile."""
         self._emit(tile, EventOp.THREAD_START, 0, 0, 0)
 
+    def enable_models(self, tile: int) -> None:
+        """Region-of-interest start (CarbonEnableModels): timing + counters
+        resume globally."""
+        self._emit(tile, EventOp.ENABLE_MODELS, 0, 0, 0)
+
+    def disable_models(self, tile: int) -> None:
+        """Region-of-interest end (CarbonDisableModels): compute/memory
+        events fast-forward at zero cost, uncounted, until re-enabled."""
+        self._emit(tile, EventOp.DISABLE_MODELS, 0, 0, 0)
+
     def stall_until(self, tile: int, time_ps: int) -> None:
         self._emit(tile, EventOp.STALL, time_ps, 0, 0)
 

@@ -83,6 +83,10 @@ class EventOp(enum.IntEnum):
     JOIN = 19          # block until the named tile's stream is DONE
                        # (ThreadManager join protocol, thread_manager.cc)
     THREAD_START = 20  # block the stream until some tile SPAWNs this one
+    ENABLE_MODELS = 21   # region-of-interest start: turn timing models on
+                         # (CarbonEnableModels, simulator.cc:287-301)
+    DISABLE_MODELS = 22  # region-of-interest end: fast-forward (zero cost,
+                         # no counters) until re-enabled
 
 
 class MemComponent(enum.IntEnum):

@@ -48,6 +48,7 @@ _TILE_AXIS_BY_FIELD = {
     "ch_time": 1,                    # [D, T, T]
     "lq_ready": 1, "sq_ready": 1,    # [entries, T]
     "link_free_mem": 1,              # [NUM_DIRS, T]
+    "stat_icount": 1,                # [S, T] progress-trace snapshots
 }
 
 

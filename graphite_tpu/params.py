@@ -340,6 +340,19 @@ class SimParams:
     enable_power_modeling: bool
     technology_node: int
 
+    # Region-of-interest: initial models-enabled flag (reference:
+    # [general]/trigger_models_within_application + Simulator::
+    # enableModels, simulator.cc:287-301) — when triggering within the
+    # application, timing models stay off until an ENABLE_MODELS event.
+    models_enabled_at_start: bool
+
+    # Periodic sampling (reference: StatisticsManager barrier-clocked
+    # sampling statistics_manager.cc:41-114 + pin/progress_trace.cc).
+    stats_enabled: bool
+    progress_enabled: bool
+    stat_interval_ps: int
+    max_stat_samples: int
+
     # TPU engine knobs
     max_events_per_quantum: int
     directory_conflict_rounds: int
@@ -495,6 +508,18 @@ class SimParams:
             enable_core_modeling=cfg.get_bool("general/enable_core_modeling"),
             enable_power_modeling=cfg.get_bool("general/enable_power_modeling"),
             technology_node=cfg.get_int("general/technology_node"),
+            models_enabled_at_start=(
+                cfg.get_bool("general/enable_core_modeling")
+                and not cfg.get_bool(
+                    "general/trigger_models_within_application")),
+            stats_enabled=cfg.get_bool("statistics_trace/enabled"),
+            progress_enabled=cfg.get_bool("progress_trace/enabled"),
+            stat_interval_ps=int(ns_to_ps(min(
+                (cfg.get_int("statistics_trace/sampling_interval")
+                 if cfg.get_bool("statistics_trace/enabled") else 1 << 40),
+                (cfg.get_int("progress_trace/interval")
+                 if cfg.get_bool("progress_trace/enabled") else 1 << 40)))),
+            max_stat_samples=cfg.get_int("tpu/max_stat_samples", 1024),
             max_events_per_quantum=cfg.get_int("tpu/max_events_per_quantum"),
             directory_conflict_rounds=cfg.get_int("tpu/directory_conflict_rounds"),
             rounds_per_quantum=cfg.get_int("tpu/rounds_per_quantum", 4),
