@@ -1,22 +1,23 @@
-"""Round benchmark: simulated MIPS on the SPLASH-2 radix config.
+"""Round benchmark: simulated MIPS on the BASELINE.md configs.
 
-Runs the BASELINE.md config-1 workload — radix sort, 64 tiles,
-carbon_sim.cfg defaults (simple in-order cores, private L1/L2 + full-map
-MSI directory, emesh NoC, lax_barrier @ 1000 ns) — on whatever accelerator
-jax selects, and prints ONE JSON line:
+Headline: config 1 — SPLASH-2 radix, 64 tiles, carbon_sim.cfg defaults
+(simple in-order cores, private L1/L2 + full-map MSI directory, emesh
+NoC, lax_barrier @ 1000 ns) — on whatever accelerator jax selects.
+Prints ONE JSON line:
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 vs_baseline: ratio against 20 simulated MIPS — a deliberately generous
-stand-in for 64-host-thread Graphite on this workload until the reference
-is measured in-tree (the HPCA 2010 paper reports low-single-digit MIPS per
-host core; see BASELINE.md).  The compile time of the fused step is
-excluded (one throwaway warm-up run), matching how the reference's numbers
-exclude Pin instrumentation warm-up.
+stand-in for 64-host-thread Graphite (the reference cannot be measured
+in this image: its build needs Boost + Pin 2.13 — see BASELINE.md
+"Measurement attempt"; HPCA 2010 reports single-digit-to-low-tens
+aggregate MIPS for this class of workload).  Compile time of the fused
+step is excluded (one throwaway warm-up run), matching how the
+reference's numbers exclude Pin instrumentation warm-up.
 
-detail also carries a 256-tile scaling point (same trace family, bounded
-steps) plus events/sec and host-seconds-per-simulated-megacycle, per the
-round-1 review.
+detail carries BASELINE config-2 points (fft/lu at 256 tiles, bounded
+steps) and radix scaling points at 256/1024 tiles, each with events/sec
+and host-seconds-per-simulated-megacycle.
 """
 
 from __future__ import annotations
@@ -30,17 +31,15 @@ NUM_TILES = 64
 KEYS_PER_TILE = 2048
 
 
-def _run(num_tiles: int, keys_per_tile: int, max_steps=None):
+def _run(trace_fn, num_tiles: int, max_steps=None):
     from graphite_tpu.config import load_config
     from graphite_tpu.engine.sim import Simulator
-    from graphite_tpu.events import synth
     from graphite_tpu.params import SimParams
 
     cfg = load_config()
     cfg.set("general/total_cores", num_tiles)
     params = SimParams.from_config(cfg)
-    trace = synth.gen_radix(num_tiles, keys_per_tile=keys_per_tile,
-                            radix=256)
+    trace = trace_fn(num_tiles)
 
     warm = Simulator(params, trace)
     warm.run(max_steps=2)
@@ -50,11 +49,15 @@ def _run(num_tiles: int, keys_per_tile: int, max_steps=None):
     summary = sim.run(max_steps=max_steps)
     host_s = time.perf_counter() - t0
     d = summary.to_dict()
+    events = int(sum(int(v.sum()) for k, v in summary.counters.items()
+                     if k in ("l1d_read", "l1d_write", "branches"))) \
+        + summary.total_instructions
     return {
         "num_tiles": num_tiles,
         "total_instructions": summary.total_instructions,
         "host_seconds": round(host_s, 3),
         "mips": round(summary.total_instructions / host_s / 1e6, 3),
+        "events_per_sec": round(events / host_s),
         "completion_time_ns": d["completion_time_ns"],
         "device_steps": sim.steps,
         "all_done": d["all_done"],
@@ -66,19 +69,29 @@ def _run(num_tiles: int, keys_per_tile: int, max_steps=None):
 
 
 def main() -> int:
-    main_run = _run(NUM_TILES, KEYS_PER_TILE)
-    scale_run = _run(256, 1024, max_steps=24)
-    mips = main_run["mips"]
-    print(json.dumps({
+    from graphite_tpu.events import synth
+
+    radix = lambda keys: (
+        lambda T: synth.gen_radix(T, keys_per_tile=keys, radix=256))
+    main_run = _run(radix(KEYS_PER_TILE), NUM_TILES)
+    out = {
         "metric": "simulated_mips_radix64",
-        "value": mips,
+        "value": main_run["mips"],
         "unit": "MIPS",
-        "vs_baseline": round(mips / BASELINE_MIPS, 3),
-        "detail": {
-            "radix64": main_run,
-            "radix256_scaling_point": scale_run,
-        },
-    }))
+        "vs_baseline": round(main_run["mips"] / BASELINE_MIPS, 3),
+        "detail": {"radix64": main_run},
+    }
+    det = out["detail"]
+    # BASELINE config 1 scaling: radix at 256 and 1024 tiles.
+    det["radix256_scaling_point"] = _run(radix(1024), 256, max_steps=24)
+    det["radix1024_scaling_point"] = _run(radix(256), 1024, max_steps=8)
+    # BASELINE config 2: directory-MSI coherence stress at 256 tiles.
+    det["fft256"] = _run(
+        lambda T: synth.gen_fft(T, points_per_tile=256), 256, max_steps=16)
+    det["lu256"] = _run(
+        lambda T: synth.gen_lu(T, matrix_blocks=8, block_lines=4), 256,
+        max_steps=16)
+    print(json.dumps(out))
     return 0
 
 

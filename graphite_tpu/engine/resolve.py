@@ -1237,13 +1237,45 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
     tok_bc_nc = dense.binsum(oh_c, tok_win & is_bc, 1) > 0   # [NC]
     has_tok_nc = dense.binsum(oh_c, tok_win, 1) > 0
 
-    # Waiter eligibility against its cond's elected token.
+    # Waiter eligibility against its cond's elected token.  Strict mode
+    # enforces pthread lost-signal semantics in simulated time (a waiter
+    # must have parked at or before the token); replay mode (captured
+    # traces) accepts any parked waiter — the native run already proved
+    # the pairing — waking at max(park, token time).
     wt = _sel(oh_c, tok_time_nc)
     w_has = _sel(oh_c, has_tok_nc.astype(jnp.int32)) > 0
     w_bc = _sel(oh_c, tok_bc_nc.astype(jnp.int32)) > 0
-    elig = is_cw & w_has & (t <= wt)
+    if params.cond_replay:
+        elig = is_cw & w_has
+        wake_at = jnp.maximum(t, wt)
+    else:
+        elig = is_cw & w_has & (t <= wt)
+        wake_at = wt
     first = _elect(elig, _fcfs_keys(elig, t), cid, NC)
     wake = jnp.where(w_bc, elig, first)
+    if params.cond_replay:
+        # Orphaned recorded waits: simulated retiming can push a captured
+        # COND_WAIT past the signal that natively woke it (the token was
+        # rightly lost before the waiter arrived).  The native run proves
+        # the waiter WAS woken, so once the system is sync-quiesced (every
+        # live tile parked on a pure-sync kind — memory/send parks
+        # self-resolve) and no token exists for its cond, the waiter
+        # wakes spuriously at its own park time.
+        # (PEND_SEND counts as sync here: a full channel only drains when
+        # its receiver runs, so a sender parked behind the orphan must not
+        # block the rescue.  A parked RECV/SEND that was about to
+        # self-resolve can make the rescue fire one pass early — a timing
+        # approximation, never a hang.)
+        k = state.pend_kind
+        pure_sync = ((k == PEND_COND) | (k == PEND_MUTEX)
+                     | (k == PEND_BARRIER) | (k == PEND_RECV)
+                     | (k == PEND_SEND) | (k == PEND_JOIN)
+                     | (k == PEND_START) | (k == PEND_CSIG)
+                     | (k == PEND_CBC))
+        quiesce = ~jnp.any(~state.done & ~pure_sync)
+        orphan = is_cw & ~w_has & quiesce
+        wake = wake | orphan
+        wake_at = jnp.where(orphan, t, wake_at)
 
     p_nu = _period(state, DVFSModule.NETWORK_USER)
     mcp = mcp_tile(params)
@@ -1274,7 +1306,18 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
     lb_excl = jnp.where(lb == m1, m2, m1)      # min over the OTHER tiles
     woke_nc = dense.binsum(oh_c, wake & ~w_bc, 1) > 0
     woke_mine = _sel(oh_c, woke_nc.astype(jnp.int32)) > 0
-    tok_done = tok_win & ((t < lb_excl) | (is_sig & woke_mine))
+    if params.cond_replay:
+        # A token is lost only when no waiter for its cond is parked AND
+        # no tile is runnable (nothing can still reach its COND_WAIT) —
+        # sound for traces whose native run completed.
+        any_runnable = (~state.done
+                        & (state.pend_kind == PEND_NONE)).any()
+        waiter_nc = dense.binsum(oh_c, is_cw, 1) > 0
+        no_waiter = ~(_sel(oh_c, waiter_nc.astype(jnp.int32)) > 0)
+        tok_done = tok_win & ((is_sig & woke_mine)
+                              | (~any_runnable & no_waiter))
+    else:
+        tok_done = tok_win & ((t < lb_excl) | (is_sig & woke_mine))
 
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
     from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
@@ -1287,13 +1330,13 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
         pend_kind=jnp.where(wake, PEND_MUTEX, state.pend_kind),
         pend_addr=jnp.where(wake, state.pend_aux.astype(jnp.int64),
                             state.pend_addr),
-        pend_issue=jnp.where(wake, wt - to_mcp, state.pend_issue),
+        pend_issue=jnp.where(wake, wake_at - to_mcp, state.pend_issue),
         counters=c._replace(
             # Stall charged here covers [park, handoff-to-mutex); the
-            # mutex _unblock then adds [wt - to_mcp, completion) — the
-            # to_mcp subtraction avoids double-counting that overlap.
+            # mutex _unblock then adds [wake_at - to_mcp, completion) —
+            # the to_mcp subtraction avoids double-counting that overlap.
             sync_stall_ps=c.sync_stall_ps + jnp.where(
-                wake, jnp.maximum(wt - to_mcp - t, 0), 0)))
+                wake, jnp.maximum(wake_at - to_mcp - t, 0), 0)))
     # Ack the resolved posters.
     return _unblock(state, tok_done, t + from_mcp + cycle_ps, sync=True)
 

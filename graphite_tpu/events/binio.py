@@ -1,0 +1,103 @@
+"""Binary trace IO — the native frontend's wire format.
+
+``native/`` (libcarbon_trace) captures a real pthreads application's
+events into this format (header + per-tile record arrays); this module
+loads it into a ``Trace``, performing the two frontend duties the C++
+side leaves to the host:
+
+  * **address compaction** — native pointers are 47-bit host VAs, beyond
+    the engine's 2^37 address budget (int32 line ids); pages are remapped
+    to dense ids preserving intra-page locality (set indexing and line
+    adjacency within a page survive; cross-page adjacency of a sparse
+    host heap carries no simulation meaning),
+  * **cache-line splitting** — one MEM event per touched line, arg2=1 on
+    continuations (the reference splits in Core::initiateMemoryAccess,
+    core.cc:173-245).
+
+Format (little-endian):
+    8 bytes   magic "GTPUTRC1"
+    u32       num_tiles
+    per tile: u32 count, then count x { i32 op, pad32, i64 addr, i32 arg,
+              i32 arg2 }  (the C struct layout of native/src Event)
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from graphite_tpu.events.schema import Trace
+from graphite_tpu.isa import EventOp
+
+MAGIC = b"GTPUTRC1"
+PAGE_BITS = 12
+_REC = np.dtype([("op", "<i4"), ("_pad", "<i4"), ("addr", "<i8"),
+                 ("arg", "<i4"), ("arg2", "<i4")])
+
+_MEM_OPS = (int(EventOp.MEM_READ), int(EventOp.MEM_WRITE),
+            int(EventOp.ATOMIC))
+
+
+def load_binary_trace(path: str, line_size: int = 64) -> Trace:
+    with open(path, "rb") as f:
+        if f.read(8) != MAGIC:
+            raise ValueError(f"{path}: not a graphite_tpu binary trace")
+        (num_tiles,) = struct.unpack("<I", f.read(4))
+        per_tile = []
+        for _ in range(num_tiles):
+            (n,) = struct.unpack("<I", f.read(4))
+            per_tile.append(np.frombuffer(f.read(n * _REC.itemsize),
+                                          dtype=_REC))
+
+    # ---- address compaction over every page TOUCHED by any access (not
+    # just start pages — a straddling access must not spill into an
+    # unrelated host page's compacted id)
+    page_sz = 1 << PAGE_BITS
+    touched = set()
+    mem_masks = [np.isin(r["op"], _MEM_OPS) for r in per_tile]
+    for rec, m in zip(per_tile, mem_masks):
+        for a, sz in zip(rec["addr"][m], rec["arg"][m]):
+            a, sz = int(a), max(1, int(sz))
+            touched.update(range(a >> PAGE_BITS,
+                                 ((a + sz - 1) >> PAGE_BITS) + 1))
+    page_map = {p: i for i, p in enumerate(sorted(touched))}
+
+    # ---- page-bounded splitting, per-piece remap, line splitting
+    events = [[] for _ in range(num_tiles)]
+    for t, rec in enumerate(per_tile):
+        out = events[t]
+        for op, a, arg, arg2 in zip(rec["op"], rec["addr"], rec["arg"],
+                                    rec["arg2"]):
+            op, a, arg, arg2 = int(op), int(a), int(arg), int(arg2)
+            if op in _MEM_OPS:
+                end = a + max(1, arg)
+                first = True
+                while a < end:
+                    ca = (page_map[a >> PAGE_BITS] << PAGE_BITS) \
+                        | (a & (page_sz - 1))
+                    nxt = min((a // line_size + 1) * line_size,
+                              (a // page_sz + 1) * page_sz, end)
+                    out.append((op, ca, nxt - a, 0 if first else 1))
+                    a = nxt
+                    first = False
+            else:
+                out.append((op, a, arg, arg2))
+        if not out or out[-1][0] != int(EventOp.DONE):
+            out.append((int(EventOp.DONE), 0, 0, 0))
+
+    n = max(len(e) for e in events)
+    ops = np.zeros((num_tiles, n), dtype=np.int32)
+    addr = np.zeros((num_tiles, n), dtype=np.int64)
+    arg = np.zeros((num_tiles, n), dtype=np.int32)
+    arg2 = np.zeros((num_tiles, n), dtype=np.int32)
+    for t, evs in enumerate(events):
+        if not evs:
+            continue
+        a = np.asarray(evs, dtype=np.int64)
+        k = len(evs)
+        ops[t, :k] = a[:, 0]
+        addr[t, :k] = a[:, 1]
+        arg[t, :k] = a[:, 2]
+        arg2[t, :k] = a[:, 3]
+    return Trace(ops=ops, addr=addr, arg=arg, arg2=arg2)

@@ -213,6 +213,163 @@ def gen_radix(num_tiles: int, keys_per_tile: int = 4096, radix: int = 256,
     return trace
 
 
+def gen_fft(num_tiles: int, points_per_tile: int = 1024,
+            line_size: int = 64) -> Trace:
+    """Address-accurate SPLASH-2 FFT trace (reference:
+    tests/benchmarks/fft/fft.C — the six-step 1D radix-sqrt(n) FFT).
+
+    Each tile owns ``points_per_tile`` complex points (16 B each) of the
+    sqrt(n) x sqrt(n) matrix, laid out in a shared array.  The six-step
+    structure is: transpose, local 1D FFTs, transpose, local FFTs,
+    transpose — the transposes are the all-to-all: each tile reads a
+    block from EVERY other tile's partition and writes into its own,
+    which is the communication signature FFT stresses at 256 tiles
+    (BASELINE config 2).
+    """
+    tb = TraceBuilder(num_tiles, line_size=line_size)
+    elem = 16                                  # complex double
+    part = points_per_tile * elem              # bytes per tile partition
+    src = SHARED_BASE                          # shared matrix
+    dst = SHARED_BASE + 0x1000_0000            # transpose target
+    # points exchanged with each partner per transpose
+    blk = max(1, points_per_tile // max(1, num_tiles))
+    log_n = max(1, (points_per_tile * num_tiles).bit_length() - 1)
+
+    def transpose(t, phase):
+        for p in range(num_tiles):
+            for i in range(blk):
+                a_src = src + p * part + (t * blk + i) * elem
+                a_dst = dst + t * part + (p * blk + i) * elem
+                tb.compute(t, 2, 2)
+                tb.read(t, a_src, elem)
+                tb.write(t, a_dst, elem)
+        tb.barrier(t, phase, num_tiles)
+
+    def local_fft(t, phase):
+        # 1D FFTs over the tile's own rows: ~5 log2(n) flops per point,
+        # sequential read-modify-write sweep.
+        for i in range(points_per_tile):
+            tb.compute(t, 5 * log_n, 5 * log_n)
+            a = dst + t * part + i * elem
+            tb.read(t, a, elem)
+            tb.write(t, a, elem)
+        tb.barrier(t, phase, num_tiles)
+
+    for t in range(num_tiles):
+        transpose(t, 0)
+        local_fft(t, 1)
+        transpose(t, 2)
+        local_fft(t, 3)
+        transpose(t, 4)
+    return tb.build()
+
+
+def gen_lu(num_tiles: int, matrix_blocks: int = 8, block_lines: int = 4,
+           line_size: int = 64) -> Trace:
+    """Address-accurate SPLASH-2 LU trace (reference:
+    tests/benchmarks/lu/contiguous_blocks/lu.C).
+
+    The B x B block-decomposed factorization: at step k the diagonal
+    block's owner factors it; owners of perimeter blocks (row/column k)
+    then read the DIAGONAL block and update; owners of interior blocks
+    read their two perimeter blocks and update — producer-consumer
+    sharing at block granularity, the directory-MSI stress of BASELINE
+    config 2.  Blocks are assigned round-robin (2D scatter).
+    """
+    tb = TraceBuilder(num_tiles, line_size=line_size)
+    nb = matrix_blocks
+    blk_bytes = block_lines * line_size
+
+    def block_addr(i, j):
+        return SHARED_BASE + (i * nb + j) * blk_bytes
+
+    def owner(i, j):
+        return (i * nb + j) % num_tiles
+
+    def sweep(t, i, j, reads, writes=True, flops=8):
+        """Read the listed source blocks line by line, update own block."""
+        for li in range(block_lines):
+            for (ri, rj) in reads:
+                tb.read(t, block_addr(ri, rj) + li * line_size, 8)
+            tb.compute(t, flops * len(reads) + flops, flops)
+            if writes:
+                tb.write(t, block_addr(i, j) + li * line_size, 8)
+
+    bar = 0
+    for k in range(nb):
+        # diagonal factorization by its owner
+        t = owner(k, k)
+        sweep(t, k, k, reads=[(k, k)], flops=12)
+        for tt in range(num_tiles):
+            tb.barrier(tt, bar % 16, num_tiles)
+        bar += 1
+        # perimeter updates read the diagonal block
+        for j in range(k + 1, nb):
+            sweep(owner(k, j), k, j, reads=[(k, k)])
+            sweep(owner(j, k), j, k, reads=[(k, k)])
+        for tt in range(num_tiles):
+            tb.barrier(tt, bar % 16, num_tiles)
+        bar += 1
+        # interior updates read their row/column perimeter blocks
+        for i in range(k + 1, nb):
+            for j in range(k + 1, nb):
+                sweep(owner(i, j), i, j, reads=[(i, k), (k, j)])
+        for tt in range(num_tiles):
+            tb.barrier(tt, bar % 16, num_tiles)
+        bar += 1
+    return tb.build()
+
+
+def gen_barnes(num_tiles: int, bodies_per_tile: int = 64,
+               interactions_per_body: int = 16, iterations: int = 2,
+               hot_cells: int = 32, seed: int = 0,
+               line_size: int = 64) -> Trace:
+    """Address-accurate SPLASH-2 Barnes-Hut trace (reference:
+    tests/benchmarks/barnes/).
+
+    Per iteration: (1) tree build — every tile writes its bodies' cell
+    links into the shared tree region (scattered shared writes);
+    (2) force computation — for each body, walk the tree: reads of the
+    HOT top-level cells (read by all tiles — wide sharing) mixed with
+    random deeper body records (sparse sharing); (3) position update —
+    private writes.  Captures the irregular read-mostly sharing that
+    makes barnes a directory stress.
+    """
+    rng = np.random.default_rng(seed)
+    tb = TraceBuilder(num_tiles, line_size=line_size)
+    body_bytes = 64                          # one body record = one line
+    tree = SHARED_BASE                       # shared cell array
+    bodies = SHARED_BASE + 0x1000_0000       # shared body array
+    n_bodies = num_tiles * bodies_per_tile
+
+    for it in range(iterations):
+        for t in range(num_tiles):
+            # (1) tree build: insert own bodies (scattered shared writes)
+            for i in range(bodies_per_tile):
+                cell = int(rng.integers(0, hot_cells * 8))
+                tb.compute(t, 10, 10)
+                tb.write(t, tree + cell * body_bytes, 8)
+            tb.barrier(t, (3 * it) % 16, num_tiles)
+            # (2) force computation: hot-cell reads + random body reads
+            for i in range(bodies_per_tile):
+                for k in range(interactions_per_body):
+                    if k % 4 == 0:      # top-of-tree cell, read by all
+                        cell = int(rng.integers(0, hot_cells))
+                        tb.read(t, tree + cell * body_bytes, 8)
+                    else:               # random remote body
+                        b = int(rng.integers(0, n_bodies))
+                        tb.read(t, bodies + b * body_bytes, 8)
+                    tb.compute(t, 12, 12)
+            tb.barrier(t, (3 * it + 1) % 16, num_tiles)
+            # (3) update own bodies
+            for i in range(bodies_per_tile):
+                own = t * bodies_per_tile + i
+                tb.compute(t, 8, 8)
+                tb.write(t, bodies + own * body_bytes, 8)
+            tb.barrier(t, (3 * it + 2) % 16, num_tiles)
+    return tb.build()
+
+
 GENERATORS = {
     "compute": gen_compute,
     "private_mem": gen_private_mem,
@@ -223,4 +380,7 @@ GENERATORS = {
     "barrier_compute": gen_barrier_compute,
     "lock_contention": gen_lock_contention,
     "radix": gen_radix,
+    "fft": gen_fft,
+    "lu": gen_lu,
+    "barnes": gen_barnes,
 }

@@ -364,6 +364,12 @@ class SimParams:
     # per-round invalidation scatter at [budget, T] instead of [T, T].
     max_inv_fanout_per_round: int
     channel_depth: int
+    # Captured-trace replay: a recorded COND_WAIT provably consumed SOME
+    # signal in the native run, but simulated retiming can invert the
+    # recorded wait/signal pair; replay mode wakes waiters on any
+    # outstanding token at max(park, token time) instead of enforcing
+    # strict lost-signal eligibility (engine/resolve.resolve_cond).
+    cond_replay: bool
 
     @property
     def line_size(self) -> int:
@@ -528,4 +534,5 @@ class SimParams:
                 "tpu/max_inv_fanout_per_round", 8),
                 "tpu/max_inv_fanout_per_round"),
             channel_depth=cfg.get_int("tpu/channel_depth", 16),
+            cond_replay=cfg.get_bool("tpu/cond_replay", False),
         )

@@ -1,0 +1,115 @@
+/* carbon_trace.h — event-capture frontend API for graphite_tpu.
+ *
+ * The TPU-native analog of the reference's standalone (no-Pin) user API
+ * (reference: common/user/carbon_user.h:18-24 CarbonStartSim/StopSim,
+ * common/user/capi.h:18-24 CAPI messaging, common/user/thread_support.h
+ * spawn/join, common/user/sync_api.h mutex/cond/barrier): a real pthreads
+ * application links against libcarbon_trace, runs natively at full speed,
+ * and every Carbon* call plus every annotated memory access is captured
+ * into per-tile event streams written in graphite_tpu's binary trace
+ * format (loaded by graphite_tpu.events.binio, simulated by the engine).
+ *
+ * Functional execution is native (like the reference's lite mode: real
+ * memory holds real data); only the EVENTS are recorded.  Threads map
+ * 1:1 onto simulated tiles in spawn order; the main thread is tile 0.
+ */
+
+#ifndef CARBON_TRACE_H
+#define CARBON_TRACE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Event opcodes — must match graphite_tpu.isa.EventOp. */
+enum CarbonEventOp {
+    CARBON_EV_NOP = 0,
+    CARBON_EV_COMPUTE = 1,
+    CARBON_EV_MEM_READ = 2,
+    CARBON_EV_MEM_WRITE = 3,
+    CARBON_EV_BRANCH = 4,
+    CARBON_EV_RECV = 5,
+    CARBON_EV_SEND = 6,
+    CARBON_EV_SYNC = 7,
+    CARBON_EV_SPAWN = 8,
+    CARBON_EV_STALL = 9,
+    CARBON_EV_DVFS_SET = 10,
+    CARBON_EV_ATOMIC = 11,
+    CARBON_EV_DONE = 12,
+    CARBON_EV_BARRIER_WAIT = 13,
+    CARBON_EV_MUTEX_LOCK = 14,
+    CARBON_EV_MUTEX_UNLOCK = 15,
+    CARBON_EV_COND_WAIT = 16,
+    CARBON_EV_COND_SIGNAL = 17,
+    CARBON_EV_COND_BROADCAST = 18,
+    CARBON_EV_JOIN = 19,
+    CARBON_EV_THREAD_START = 20,
+    CARBON_EV_ENABLE_MODELS = 21,
+    CARBON_EV_DISABLE_MODELS = 22
+};
+
+/* ---- lifecycle (carbon_user.h) ---- */
+/* Initialize capture for up to max_tiles threads; the caller becomes
+ * tile 0.  Returns 0 on success. */
+int CarbonStartSim(int max_tiles);
+/* Finish capture and write the trace file; returns 0 on success. */
+int CarbonStopSim(const char *trace_path);
+int CarbonGetTileId(void);
+
+/* ---- region of interest (performance_counter_support.h) ---- */
+void CarbonEnableModels(void);
+void CarbonDisableModels(void);
+
+/* ---- thread lifecycle (thread_support.h) ---- */
+typedef void *(*carbon_thread_func_t)(void *);
+/* Spawn a new thread on the next free tile; returns its tile id, or -1. */
+int CarbonSpawnThread(carbon_thread_func_t func, void *arg);
+/* Join the thread running on `tile`. */
+int CarbonJoinThread(int tile);
+
+/* ---- sync API (sync_api.h) ---- */
+typedef int carbon_mutex_t;
+typedef int carbon_cond_t;
+typedef int carbon_barrier_t;
+void CarbonMutexInit(carbon_mutex_t *mux);
+void CarbonMutexLock(carbon_mutex_t *mux);
+void CarbonMutexUnlock(carbon_mutex_t *mux);
+void CarbonCondInit(carbon_cond_t *cond);
+void CarbonCondWait(carbon_cond_t *cond, carbon_mutex_t *mux);
+void CarbonCondSignal(carbon_cond_t *cond);
+void CarbonCondBroadcast(carbon_cond_t *cond);
+void CarbonBarrierInit(carbon_barrier_t *barrier, int count);
+void CarbonBarrierWait(carbon_barrier_t *barrier);
+
+/* ---- CAPI messaging (capi.h) ---- */
+/* Blocking send/receive between tiles; data moves through an internal
+ * channel (functional), SEND/RECV events are recorded (timing). */
+int CAPI_message_send_w(int sender, int receiver, const char *buf,
+                        int size);
+int CAPI_message_receive_w(int sender, int receiver, char *buf, int size);
+
+/* ---- instrumentation (the Pin analysis-call analog) ---- */
+/* Record a run of `icount` non-memory instructions costing `cycles`. */
+void CarbonCompute(int cycles, int icount);
+/* Record (and natively perform, through the returned pointer semantics)
+ * a modeled memory access; the access itself is the caller's load/store —
+ * these record the event like lite::handleMemoryRead/Write. */
+void CarbonMemRead(const void *addr, int size);
+void CarbonMemWrite(void *addr, int size);
+void CarbonAtomic(void *addr, int size);
+void CarbonBranch(int taken);
+
+/* Convenience macros: annotate-and-access. */
+#define CARBON_LOAD(type, ptr) \
+    (CarbonMemRead((ptr), sizeof(type)), *(type *)(ptr))
+#define CARBON_STORE(type, ptr, val) \
+    (CarbonMemWrite((ptr), sizeof(type)), (void)(*(type *)(ptr) = (val)))
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* CARBON_TRACE_H */

@@ -1,0 +1,288 @@
+/* carbon_trace.cc — event-capture runtime.
+ *
+ * Re-creates the reference's standalone user runtime (reference:
+ * common/user/carbon_user.cc:22-69 startup, thread_support.cc spawn glue,
+ * sync_api.cc forwarding, capi.cc messaging) as a CAPTURE library: the
+ * application executes natively under real pthreads; every API call and
+ * annotated access appends one event record to the calling thread's
+ * per-tile buffer.  CarbonStopSim serializes all buffers into the binary
+ * trace format consumed by graphite_tpu.events.binio.
+ *
+ * Sync objects here are REAL pthread objects (the app must behave
+ * correctly natively); the recorded events let the engine re-time the
+ * same schedule under the simulated machine's latencies.
+ */
+
+#include "carbon_trace.h"
+
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <atomic>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Event {
+    int32_t op;
+    int32_t pad;      /* explicit: keeps fwrite deterministic byte-wise */
+    int64_t addr;
+    int32_t arg;
+    int32_t arg2;
+};
+
+struct TileBuf {
+    std::vector<Event> events;
+    pthread_t thread{};
+    bool joined = false;
+};
+
+struct Channel {
+    std::deque<std::vector<char>> msgs;
+    pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+    pthread_cond_t cv = PTHREAD_COND_INITIALIZER;
+};
+
+struct Runtime {
+    std::vector<TileBuf> tiles;
+    std::atomic<int> next_tile{1};
+    std::atomic<int> next_mutex{0};
+    std::atomic<int> next_cond{0};
+    std::atomic<int> next_barrier{0};
+    std::map<int, pthread_mutex_t *> mutexes;
+    std::map<int, pthread_cond_t *> conds;
+    std::map<int, pthread_barrier_t *> barriers;
+    std::map<int, int> barrier_count;
+    std::map<long, Channel *> channels;   /* key = sender * maxT + recv */
+    std::mutex object_mu;
+    int max_tiles = 0;
+    bool started = false;
+};
+
+Runtime *g_rt = nullptr;
+thread_local int tl_tile = -1;
+
+void emit(int op, int64_t addr = 0, int arg = 0, int arg2 = 0) {
+    if (!g_rt || tl_tile < 0) return;
+    g_rt->tiles[tl_tile].events.push_back(
+        Event{(int32_t)op, 0, addr, (int32_t)arg, (int32_t)arg2});
+}
+
+/* Locked lookups: *Init inserts under object_mu; concurrent readers must
+ * too (std::map mutation during lookup is UB).  Unknown ids fail loudly. */
+template <typename M>
+typename M::mapped_type lookup(M &m, int id, const char *what) {
+    std::lock_guard<std::mutex> g(g_rt->object_mu);
+    auto it = m.find(id);
+    if (it == m.end()) {
+        fprintf(stderr, "carbon_trace: %s %d used before Init\n", what, id);
+        abort();
+    }
+    return it->second;
+}
+
+struct SpawnArgs {
+    carbon_thread_func_t func;
+    void *arg;
+    int tile;
+};
+
+void *spawn_trampoline(void *p) {
+    SpawnArgs *sa = (SpawnArgs *)p;
+    tl_tile = sa->tile;
+    /* The child's stream is gated on its SPAWN (thread_manager.cc
+     * masterSpawnThread -> slave start). */
+    emit(CARBON_EV_THREAD_START);
+    void *ret = sa->func(sa->arg);
+    emit(CARBON_EV_DONE);
+    delete sa;
+    return ret;
+}
+
+Channel *channel(int sender, int receiver) {
+    std::lock_guard<std::mutex> g(g_rt->object_mu);
+    long key = (long)sender * g_rt->max_tiles + receiver;
+    auto it = g_rt->channels.find(key);
+    if (it != g_rt->channels.end()) return it->second;
+    Channel *ch = new Channel();
+    g_rt->channels[key] = ch;
+    return ch;
+}
+
+}  // namespace
+
+extern "C" {
+
+int CarbonStartSim(int max_tiles) {
+    if (g_rt) return -1;
+    g_rt = new Runtime();
+    g_rt->max_tiles = max_tiles;
+    g_rt->tiles.resize(max_tiles);
+    g_rt->started = true;
+    tl_tile = 0;
+    return 0;
+}
+
+int CarbonStopSim(const char *trace_path) {
+    if (!g_rt) return -1;
+    if (tl_tile == 0) emit(CARBON_EV_DONE);
+    FILE *f = fopen(trace_path, "wb");
+    if (!f) return -1;
+    /* Header: magic, version, tile count (see events/binio.py). */
+    const char magic[8] = {'G', 'T', 'P', 'U', 'T', 'R', 'C', '1'};
+    fwrite(magic, 1, 8, f);
+    uint32_t ntiles = (uint32_t)g_rt->max_tiles;
+    fwrite(&ntiles, sizeof(uint32_t), 1, f);
+    for (auto &tb : g_rt->tiles) {
+        uint32_t n = (uint32_t)tb.events.size();
+        fwrite(&n, sizeof(uint32_t), 1, f);
+        if (n) fwrite(tb.events.data(), sizeof(Event), n, f);
+    }
+    fclose(f);
+    delete g_rt;
+    g_rt = nullptr;
+    return 0;
+}
+
+int CarbonGetTileId(void) { return tl_tile; }
+
+void CarbonEnableModels(void) { emit(CARBON_EV_ENABLE_MODELS); }
+void CarbonDisableModels(void) { emit(CARBON_EV_DISABLE_MODELS); }
+
+int CarbonSpawnThread(carbon_thread_func_t func, void *arg) {
+    int tile = g_rt->next_tile.fetch_add(1);
+    if (tile >= g_rt->max_tiles) return -1;
+    emit(CARBON_EV_SPAWN, 0, /*cost*/ 0, tile);
+    SpawnArgs *sa = new SpawnArgs{func, arg, tile};
+    if (pthread_create(&g_rt->tiles[tile].thread, nullptr,
+                       spawn_trampoline, sa) != 0) {
+        delete sa;
+        return -1;
+    }
+    return tile;
+}
+
+int CarbonJoinThread(int tile) {
+    if (tile <= 0 || tile >= g_rt->max_tiles) return -1;
+    emit(CARBON_EV_JOIN, 0, 0, tile);
+    if (!g_rt->tiles[tile].joined) {
+        pthread_join(g_rt->tiles[tile].thread, nullptr);
+        g_rt->tiles[tile].joined = true;
+    }
+    return 0;
+}
+
+/* ---- sync objects: ids recorded for the engine, real pthread objects
+ * for native correctness ---- */
+
+void CarbonMutexInit(carbon_mutex_t *mux) {
+    *mux = g_rt->next_mutex.fetch_add(1);
+    std::lock_guard<std::mutex> g(g_rt->object_mu);
+    pthread_mutex_t *m = new pthread_mutex_t;
+    pthread_mutex_init(m, nullptr);
+    g_rt->mutexes[*mux] = m;
+}
+
+void CarbonMutexLock(carbon_mutex_t *mux) {
+    emit(CARBON_EV_MUTEX_LOCK, 0, *mux, 0);
+    pthread_mutex_lock(lookup(g_rt->mutexes, *mux, "mutex"));
+}
+
+void CarbonMutexUnlock(carbon_mutex_t *mux) {
+    pthread_mutex_unlock(lookup(g_rt->mutexes, *mux, "mutex"));
+    emit(CARBON_EV_MUTEX_UNLOCK, 0, *mux, 0);
+}
+
+void CarbonCondInit(carbon_cond_t *cond) {
+    *cond = g_rt->next_cond.fetch_add(1);
+    std::lock_guard<std::mutex> g(g_rt->object_mu);
+    pthread_cond_t *c = new pthread_cond_t;
+    pthread_cond_init(c, nullptr);
+    g_rt->conds[*cond] = c;
+}
+
+void CarbonCondWait(carbon_cond_t *cond, carbon_mutex_t *mux) {
+    emit(CARBON_EV_COND_WAIT, 0, *cond, *mux);
+    pthread_cond_wait(lookup(g_rt->conds, *cond, "cond"),
+                      lookup(g_rt->mutexes, *mux, "mutex"));
+}
+
+void CarbonCondSignal(carbon_cond_t *cond) {
+    emit(CARBON_EV_COND_SIGNAL, 0, *cond, 0);
+    pthread_cond_signal(lookup(g_rt->conds, *cond, "cond"));
+}
+
+void CarbonCondBroadcast(carbon_cond_t *cond) {
+    emit(CARBON_EV_COND_BROADCAST, 0, *cond, 0);
+    pthread_cond_broadcast(lookup(g_rt->conds, *cond, "cond"));
+}
+
+void CarbonBarrierInit(carbon_barrier_t *barrier, int count) {
+    *barrier = g_rt->next_barrier.fetch_add(1);
+    std::lock_guard<std::mutex> g(g_rt->object_mu);
+    pthread_barrier_t *b = new pthread_barrier_t;
+    pthread_barrier_init(b, nullptr, count);
+    g_rt->barriers[*barrier] = b;
+    g_rt->barrier_count[*barrier] = count;
+}
+
+void CarbonBarrierWait(carbon_barrier_t *barrier) {
+    emit(CARBON_EV_BARRIER_WAIT, 0, *barrier,
+         lookup(g_rt->barrier_count, *barrier, "barrier"));
+    pthread_barrier_wait(lookup(g_rt->barriers, *barrier, "barrier"));
+}
+
+/* ---- CAPI messaging ---- */
+
+int CAPI_message_send_w(int sender, int receiver, const char *buf,
+                        int size) {
+    emit(CARBON_EV_SEND, 0, size, receiver);
+    Channel *ch = channel(sender, receiver);
+    pthread_mutex_lock(&ch->mu);
+    ch->msgs.emplace_back(buf, buf + size);
+    pthread_cond_signal(&ch->cv);
+    pthread_mutex_unlock(&ch->mu);
+    return 0;
+}
+
+int CAPI_message_receive_w(int sender, int receiver, char *buf, int size) {
+    emit(CARBON_EV_RECV, 0, size, sender);
+    Channel *ch = channel(sender, receiver);
+    pthread_mutex_lock(&ch->mu);
+    while (ch->msgs.empty()) pthread_cond_wait(&ch->cv, &ch->mu);
+    std::vector<char> msg = ch->msgs.front();
+    ch->msgs.pop_front();
+    pthread_mutex_unlock(&ch->mu);
+    memcpy(buf, msg.data(), (size_t)size < msg.size() ? (size_t)size
+                                                      : msg.size());
+    return 0;
+}
+
+/* ---- instrumentation ---- */
+
+void CarbonCompute(int cycles, int icount) {
+    emit(CARBON_EV_COMPUTE, 0x400000, cycles, icount);
+}
+
+void CarbonMemRead(const void *addr, int size) {
+    emit(CARBON_EV_MEM_READ, (int64_t)(uintptr_t)addr, size, 0);
+}
+
+void CarbonMemWrite(void *addr, int size) {
+    emit(CARBON_EV_MEM_WRITE, (int64_t)(uintptr_t)addr, size, 0);
+}
+
+void CarbonAtomic(void *addr, int size) {
+    emit(CARBON_EV_ATOMIC, (int64_t)(uintptr_t)addr, size, 0);
+}
+
+void CarbonBranch(int taken) {
+    emit(CARBON_EV_BRANCH, 0x400000, taken, 0);
+}
+
+}  /* extern "C" */
