@@ -88,36 +88,31 @@ def fcfs(resource: jnp.ndarray, arrival: jnp.ndarray, service: jnp.ndarray,
     K = resource.shape[0]
     R = free_at.shape[0]
     res_eff = jnp.where(valid, resource, R).astype(jnp.int32)
-    # Sort by (resource, arrival); invalid sink to the end.
-    order = jnp.lexsort((arrival, res_eff))
-    r_s = res_eff[order]
-    a_s = arrival[order]
-    sv_s = jnp.where(valid[order], service[order], 0)
-
-    seg_start = jnp.concatenate(
-        [jnp.ones(1, dtype=bool), r_s[1:] != r_s[:-1]])
-    # Prefix sums of service, exclusive within segment.
-    cs = _cumsum_doubling(sv_s)
-    seg_base = _segmented_running_max(
-        jnp.where(seg_start, cs - sv_s, jnp.int64(-(2**62))), seg_start)
-    S_prev = (cs - sv_s) - seg_base          # segment-local exclusive prefix
-    S_incl = cs - seg_base                    # segment-local inclusive prefix
-    # Fold the resource's existing horizon into the first element of each
-    # segment: candidate start floor = max(arrival, free_at) at seg start.
-    base = jnp.where(seg_start,
-                     jnp.maximum(a_s, free_at[jnp.minimum(r_s, R - 1)]),
-                     a_s)
+    idx = jnp.arange(K, dtype=jnp.int32)
+    svc = jnp.where(valid, service, 0)
+    # Dense pairwise form of the same closed-form recurrence — sort-free,
+    # because XLA:TPU lowers sorts to serialized while-loops of
+    # dynamic-update-slices (profiled ~31 ms per [2048] lexsort), while a
+    # [K, K] masked compare-reduce is a few fused vector ops.
+    #   earlier[i, j] <=> j is served before i on the same resource
+    #   (FCFS by arrival, ties by row index).
+    same = valid[None, :] & valid[:, None] \
+        & (res_eff[None, :] == res_eff[:, None])
+    earlier = same & ((arrival[None, :] < arrival[:, None])
+                      | ((arrival[None, :] == arrival[:, None])
+                         & (idx[None, :] < idx[:, None])))
+    # Exclusive prefix of service in service order.
+    S_prev = jnp.sum(jnp.where(earlier, svc[None, :], 0), axis=1)
+    base = jnp.maximum(arrival, free_at[jnp.minimum(res_eff, R - 1)])
     cand = base - S_prev
-    run = _segmented_running_max(cand, seg_start)
-    start_s = run + S_prev
-    end_s = run + S_incl
-
-    # Un-sort.
-    inv = jnp.zeros(K, dtype=jnp.int32).at[order].set(
-        jnp.arange(K, dtype=jnp.int32))
-    start = start_s[inv]
-    end = end_s[inv]
+    # Running max over each row's predecessors (and itself).
+    self_or_earlier = earlier | (jnp.eye(K, dtype=bool) & valid[:, None])
+    run = jnp.max(jnp.where(self_or_earlier, cand[None, :],
+                            jnp.int64(-(2**62))), axis=1)
+    start = run + S_prev
+    end = start + svc
     delay = jnp.where(valid, start - arrival, 0)
     new_free = free_at.at[res_eff].max(jnp.where(valid, end, 0), mode="drop")
-    return FcfsResult(start=start, end=jnp.where(valid, end, 0),
+    return FcfsResult(start=jnp.where(valid, start, 0),
+                      end=jnp.where(valid, end, 0),
                       delay=delay, free_at=new_free)

@@ -31,12 +31,11 @@ from graphite_tpu.engine import directory as dirmod
 from graphite_tpu.engine import noc
 from graphite_tpu.engine import noc_flight
 from graphite_tpu.engine import queue_models
-from graphite_tpu.engine.core import _lat, _period, mcp_tile
+from graphite_tpu.engine.core import STAMP_STRIDE, _lat, _period, mcp_tile
 from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
     PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
-    PEND_START, SimState, dir_meta_lru, dir_meta_owner, dir_meta_state,
-    dir_pack)
+    PEND_START, SimState, dir_meta_owner, dir_meta_state, dir_pack)
 from graphite_tpu.isa import DVFSModule
 from graphite_tpu.params import SimParams
 
@@ -137,6 +136,31 @@ def _elect(active, packed, idx, size):
     return active & (tbl[idx] == packed)
 
 
+def _grouped_rank(group: jnp.ndarray, key: jnp.ndarray,
+                  active: jnp.ndarray, sink: int) -> jnp.ndarray:
+    """FCFS rank of each active row within its ``group``, ordered by
+    ``key``, as ONE dense [R, R] masked compare-and-sum.
+
+    Deliberately dense: [R, R] bool work is a few MB of fused vector ops
+    even at R = 2048, while sort-based ranking lowers to a serialized
+    while-loop of dynamic-update-slices on TPU (profiled at ~31 ms per
+    [2T] lexsort at T = 1024 — the round-3 engine's dominant cost until
+    replaced).  Key ties break by row index (the owner-delivery caller
+    duplicates its FCFS keys across two delivery legs, which may share a
+    target tile — without the tiebreak they'd collide on one slot).
+    Inactive rows get rank 0.
+    """
+    del sink
+    R = key.shape[0]
+    idx = jnp.arange(R, dtype=jnp.int32)
+    g = group.astype(jnp.int32)
+    before = (g[None, :] == g[:, None]) \
+        & ((key[None, :] < key[:, None])
+           | ((key[None, :] == key[:, None]) & (idx[None, :] < idx[:, None]))) \
+        & active[None, :] & active[:, None]
+    return jnp.sum(before, axis=1, dtype=jnp.int32)
+
+
 def _unblock(state: SimState, mask, completion, sync: bool) -> SimState:
     c = state.counters
     stall = jnp.where(mask, completion - state.pend_issue, 0)
@@ -217,9 +241,10 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
 
     # Conflict-round invariants, hoisted out of the loop: each pending
     # request's home/line/set and everything derived only from them.
-    oh_home = _oh(home, T)                     # [T, T]
-    p_net_home = _sel(oh_home, p_net).astype(jnp.int32)
-    p_dir_home = _sel(oh_home, p_dir).astype(jnp.int32)
+    # (Per-home values are plain [T] gathers — the old dense [T, T]
+    # one-hot selects were O(T^2) per round.)
+    p_net_home = p_net[home]
+    p_dir_home = p_dir[home]
     dense_tables = T * H <= _DENSE_MAX_ELEMS
     oh_hidx = _oh(hidx, H) if dense_tables else None
     net_req = noc.unicast_ps(params.net_memory, rows, home, CTRL_BYTES,
@@ -233,6 +258,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     def round_body(carry):
         _i, state, resolved, line_floor = carry
         unres = is_req & ~resolved
+        # Requester-cache fill stamp for this conflict round (monotone
+        # across local rounds and conflict rounds; see core.STAMP_STRIDE).
+        rstamp = state.round_ctr * STAMP_STRIDE + STAMP_STRIDE - 1
 
         # ---- earliest-per-line election (the directory FSM serialization)
         if dense_tables:
@@ -244,44 +272,51 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
 
         # ---- directory-cache probe at (home, dset), via the flat
         # (home*ndsets + dset) index — one gather per field
-        dtags = state.dir_tags.reshape(A, -1)[:, fidx].T     # [T, A]
-        dmeta = state.dir_meta.reshape(A, -1)[:, fidx].T
+        dtags = state.dir_tags[:, fidx].T                    # [T, A]
+        dmeta = state.dir_meta[:, fidx].T
+        dstamp = state.dir_stamp[:, fidx].T
         dstate = dir_meta_state(dmeta)
         match = (dtags == line[:, None].astype(jnp.int32)) & (dstate != I)
         hit = match.any(axis=1)
         hway = jnp.argmax(match, axis=1).astype(jnp.int32)
-        dlru = dir_meta_lru(dmeta)
         invalid = dstate == I
 
         # ---- victim-way assignment for allocating (miss) winners.  The
         # home directory serves same-set requests in FCFS order, each
-        # evicting the then-LRU way — so the k-th miss winner of a
-        # (home, dset) group this round takes the way with the k-th highest
-        # replacement priority (invalid ways first, then LRU rank), and
-        # ways touched by a hit winner are excluded.  Distinct ways per
+        # evicting the then-best victim — so the k-th miss winner of a
+        # (home, dset) group this round takes the way with the k-th best
+        # replacement priority (invalid ways first, then min-stamp LRU),
+        # with ways touched by a hit winner excluded.  Distinct ways per
         # group mean the winners' directory installs never collide.
-        # [T, T] dense compares — cheap on TPU; only materialized pairs
-        # would be O(T^2)-expensive.
+        # grank comes from a lexsort over (set, FCFS key) and hit-held
+        # ways from a hash table — both O(T log T), replacing the old
+        # dense [T, T](, A) comparison blocks.
         hitwin = win & hit
         misswin = win & ~hit
-        same_hs = fidx[:, None] == fidx[None, :]
-        grank = jnp.sum(
-            same_hs & (packed[None, :] < packed[:, None])
-            & misswin[:, None] & misswin[None, :], axis=1).astype(jnp.int32)
-        hway_used = jnp.any(
-            same_hs[:, :, None] & hitwin[None, :, None]
-            & (hway[None, :, None]
-               == jnp.arange(A, dtype=jnp.int32)[None, None, :]), axis=1)
-        # Replacement priority: hit-held ways never; invalid ways first
-        # (rank + A sorts them above every valid way), then LRU.
-        prio = jnp.where(hway_used, -1, dlru + jnp.where(invalid, A, 0))
+        grank = _grouped_rank(fidx, packed, misswin, T * ndsets)
+        fhash = (dense.fmix64(fidx.astype(jnp.int64))
+                 % jnp.uint64(H)).astype(jnp.int32)
+        used_tbl = jnp.zeros((H, A), dtype=bool).at[
+            jnp.where(hitwin, fhash, H), hway].set(True, mode="drop")
+        hway_used = used_tbl[fhash]                           # [T, A]
+        # Victim order key: hit-held ways never; invalid ways first, then
+        # oldest stamp, ties to the lowest way.  (A hash collision can
+        # only mark extra ways used — the loser defers a round, as with
+        # the line election.)
+        NEVER = jnp.int32(2**31 - 1)
+        vkey = jnp.where(hway_used, NEVER,
+                         jnp.where(invalid, -1, dstamp))
+        eligible = ~hway_used
+        arA0 = jnp.arange(A, dtype=jnp.int32)
         pos = jnp.sum(
-            (prio[:, None, :] > prio[:, :, None])
-            | ((prio[:, None, :] == prio[:, :, None])
-               & (jnp.arange(A)[None, None, :] < jnp.arange(A)[None, :, None])),
-            axis=2).astype(jnp.int32)          # [T, A] descending-order pos
-        n_elig = jnp.sum(prio >= 0, axis=1).astype(jnp.int32)
-        miss_way = jnp.argmax(pos == grank[:, None], axis=1).astype(jnp.int32)
+            (eligible[:, None, :]
+             & ((vkey[:, None, :] < vkey[:, :, None])
+                | ((vkey[:, None, :] == vkey[:, :, None])
+                   & (arA0[None, None, :] < arA0[None, :, None])))),
+            axis=2).astype(jnp.int32)          # [T, A] ascending victim pos
+        n_elig = jnp.sum(eligible, axis=1).astype(jnp.int32)
+        miss_way = jnp.argmax(eligible & (pos == grank[:, None]),
+                              axis=1).astype(jnp.int32)
         can_alloc = misswin & (grank < n_elig)
         way = jnp.where(hit, hway, miss_way)
 
@@ -302,8 +337,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             dstate != I, way[:, None], axis=1)[:, 0]
 
         downer = dir_meta_owner(dmeta)                        # [T, A]
-        dsharers = state.dir_sharers.reshape(
-            W, A, -1)[:, :, fidx].transpose(2, 1, 0)          # [T, A, W]
+        dsharers = state.dir_sharers[:, :, fidx].transpose(2, 1, 0)  # [T,A,W]
         entry_state = jnp.where(
             hit, jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
         entry_owner = jnp.where(
@@ -406,9 +440,12 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # granted in FCFS key order (not tile order) so a hot-spot round
         # never systematically favors low tile ids.
         need_fan = has_inv | evict_s
-        fan_keys = jnp.where(need_fan, packed, _BIG)
-        kth = -jax.lax.top_k(-fan_keys, K)[0][K - 1]   # Kth-smallest key
-        sel0 = need_fan & (packed <= kth)
+        # K earliest FCFS keys win the budget — dense rank (top_k lowers
+        # to a serialized loop on TPU, same story as _grouped_rank).
+        fan_rank = jnp.sum(
+            (packed[None, :] < packed[:, None]) & need_fan[None, :]
+            & need_fan[:, None], axis=1, dtype=jnp.int32)
+        sel0 = need_fan & (fan_rank < K)
         fan_defer = need_fan & ~sel0
         win1 = win & ~fan_defer
 
@@ -423,15 +460,10 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         tgt2 = jnp.concatenate([owner, vown_c])
         val2 = jnp.concatenate([owner_leg1, evict_m1])
         key2 = jnp.concatenate([packed, packed])
-        oh_t2 = _oh(tgt2, T) & val2[:, None]              # [2T, T]
-        if 4 * T * T * T <= 8 * _DENSE_MAX_ELEMS:
-            earlier2 = key2[:, None] > key2[None, :]
-            posr = jnp.sum(earlier2[:, :, None] & oh_t2[None, :, :],
-                           axis=1, dtype=jnp.int32)       # [2T, T]
-        else:
-            c2 = jnp.cumsum(oh_t2.astype(jnp.int32), axis=0)
-            posr = c2 - oh_t2.astype(jnp.int32)
-        over2 = (oh_t2 & (posr >= J_OWN)).any(axis=1)     # [2T]
+        # FCFS rank of each delivery within its target tile's budget
+        # (sort-based — the old dense [2T, 2T] compare was O(T^2)).
+        posr = _grouped_rank(tgt2, key2, val2, T)         # [2T]
+        over2 = val2 & (posr >= J_OWN)
         ow_defer = over2[:T] | over2[T:]
         win = win1 & ~ow_defer
         has_inv = has_inv & ~fan_defer & ~ow_defer
@@ -441,21 +473,21 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         evict_o = evicting & (vstate == O)
         owner_leg = owner_leg1 & ~ow_defer
         val2 = jnp.concatenate([owner_leg, evict_m])
-        oh_t2 = oh_t2 & val2[:, None]
 
-        # Per-target owner-delivery line lists [T, J_OWN] (dense build —
-        # surviving rows keep their unique slot rank < J_OWN).
-        oslot = oh_t2[:, :, None] & (
-            posr[:, :, None] == jnp.arange(J_OWN, dtype=jnp.int32)[None,
-                                                                   None, :])
+        # Per-target owner-delivery line lists [T, J_OWN], scatter-built —
+        # surviving rows keep their unique slot rank < J_OWN.
         lines2 = jnp.concatenate([line, vtag])
         down2 = jnp.concatenate(
             [act.owner_downgrade_to, jnp.full(T, I, dtype=jnp.int32)])
-        own_lines = jnp.sum(
-            jnp.where(oslot, lines2[:, None, None], 0), axis=0)   # [T, J]
-        own_valid = oslot.any(axis=0)
-        own_tgt = jnp.sum(jnp.where(oslot, down2[:, None, None], 0),
-                          axis=0, dtype=jnp.int32)
+        put = val2 & (posr < J_OWN)
+        tgt2_m = jnp.where(put, tgt2, T).astype(jnp.int32)
+        slot2 = jnp.minimum(posr, J_OWN - 1)
+        own_lines = jnp.zeros((T, J_OWN), dtype=lines2.dtype).at[
+            tgt2_m, slot2].set(lines2, mode="drop")
+        own_valid = jnp.zeros((T, J_OWN), dtype=bool).at[
+            tgt2_m, slot2].set(True, mode="drop")
+        own_tgt = jnp.zeros((T, J_OWN), dtype=jnp.int32).at[
+            tgt2_m, slot2].set(down2, mode="drop")
 
         sel = sel0 & ~ow_defer
         rank = queue_models._cumsum_doubling(sel.astype(jnp.int32)) - 1
@@ -500,18 +532,15 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         evict_ps = jnp.where(evict_s, jnp.sum(
             jnp.where(oh_sr, vic_ps_k[:, None], 0), axis=0), 0)
         # M-state victim: single-owner flush round trip.
-        oh_vown = _oh(vown_c, T)
-        p_net_vown = _sel(oh_vown, p_net).astype(jnp.int32)
+        p_net_vown = p_net[vown_c]
         # Owner-side lookup cost for flush/downgrade legs: the owner holds
         # the line in its private L2 — or only in its L1D under shared L2
         # (there is no private L2 there).
         if params.shared_l2:
-            l2_vown_ps = _lat(params.l1d.access_cycles, _sel(
-                oh_vown, _period(state, DVFSModule.L1_DCACHE)).astype(
-                    jnp.int32))
+            l2_vown_ps = _lat(params.l1d.access_cycles,
+                              _period(state, DVFSModule.L1_DCACHE)[vown_c])
         else:
-            p_l2_vown = _sel(oh_vown, p_l2).astype(jnp.int32)
-            l2_vown_ps = _lat(params.l2.access_cycles, p_l2_vown)
+            l2_vown_ps = _lat(params.l2.access_cycles, p_l2[vown_c])
 
         # ---- latency assembly (SURVEY.md 3.3's round trips).  Unicast
         # legs are either zero-load closed forms (magic/emesh_hop_counter)
@@ -578,15 +607,12 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         t_dir = arrive + dir_ps + scheme_dir_ps \
             + jnp.where(evicting, evict_ps, 0)
 
-        oh_owner = _oh(owner, T)
-        p_net_own = _sel(oh_owner, p_net).astype(jnp.int32)
+        p_net_own = p_net[owner]
         if params.shared_l2:
-            l2_own_ps = _lat(params.l1d.access_cycles, _sel(
-                oh_owner, _period(state, DVFSModule.L1_DCACHE)).astype(
-                    jnp.int32))
+            l2_own_ps = _lat(params.l1d.access_cycles,
+                             _period(state, DVFSModule.L1_DCACHE)[owner])
         else:
-            l2_own_ps = _lat(params.l2.access_cycles,
-                             _sel(oh_owner, p_l2).astype(jnp.int32))
+            l2_own_ps = _lat(params.l2.access_cycles, p_l2[owner])
         if contended:
             g1 = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,
@@ -614,19 +640,16 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             # miss adds slice->controller request + data-return legs
             # (zero-load; reference pr_l1_sh_l2 dram_cntlr placement).
             dsite = dram_site_of_line(params, line)
-            oh_dsite = _oh(dsite, T)
             local_ctl = home == dsite
             to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
                 params.net_memory, home, dsite, CTRL_BYTES, p_net_home,
                 params.mesh_width))
             from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
                 params.net_memory, dsite, home,
-                params.line_size + CTRL_BYTES,
-                _sel(oh_dsite, p_net).astype(jnp.int32),
+                params.line_size + CTRL_BYTES, p_net[dsite],
                 params.mesh_width))
         else:
             dsite = home
-            oh_dsite = oh_home
             to_dram_ps = from_dram_ps = jnp.int64(0)
         dram_arrival = t_dir + owner_ps + to_dram_ps
         q = queue_models.fcfs(dsite, dram_arrival,
@@ -640,8 +663,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # transitions skip DRAM entirely (act.dram_write False); dirty
         # victim evictions (M flushes, O slice lines) do land there.
         dram_wb = (act.dram_write & win) | evict_m | evict_o
-        state = state._replace(dram_free_at=q.free_at + _binsum(
-            oh_dsite, dram_wb, dram_service_ps))
+        wb_occ = jnp.zeros(T, dtype=jnp.int64).at[
+            jnp.where(dram_wb, dsite, T)].add(dram_service_ps, mode="drop")
+        state = state._replace(dram_free_at=q.free_at + wb_occ)
 
         t_data = t_dir + owner_ps
         t_data = jnp.maximum(t_data, jnp.where(need_read, dram_ready, 0))
@@ -670,57 +694,24 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             completion = reply_done + l2_fill_ps + l1_fill_ps \
                 + state.pend_extra
 
-        # ---- apply directory entry updates: merged whole-row writes.
-        # Several same-set winners per round are the common case (distinct
-        # ways by design), so the row written must reflect ALL of the
-        # set's installs: each winner computes the identical merged row —
-        # every touched way carries its toucher's new tag/state/owner/
-        # sharers; LRU ranks touched ways by touch recency (latest FCFS
-        # key = MRU = 0) with untouched ways following in pre-round
-        # relative order — and the colliding whole-row scatters agree.
-        home_w = jnp.where(win, home, T).astype(jnp.int32)
-        sw = same_hs[:, :, None] & win[None, :, None] & (
-            way[None, :, None] == jnp.arange(A, dtype=jnp.int32)[None, None, :])
-        touched = jnp.any(sw, axis=1)                               # [T, A]
-
-        def merge(vals, old):  # [T] per-winner value -> [T, A] merged row
-            m = jnp.sum(jnp.where(sw, vals[None, :, None], 0), axis=1,
-                        dtype=old.dtype)
-            return jnp.where(touched, m, old)
-
-        tkey = jnp.sum(jnp.where(sw, packed[None, :, None], 0), axis=1)
-        n_touch = jnp.sum(touched, axis=1, dtype=jnp.int32)
-        rank_t = jnp.sum(
-            touched[:, None, :] & (tkey[:, None, :] > tkey[:, :, None]),
-            axis=2, dtype=jnp.int32)
-        rank_u = n_touch[:, None] + jnp.sum(
-            ~touched[:, None, :] & (dlru[:, None, :] < dlru[:, :, None]),
-            axis=2, dtype=jnp.int32)
-        row_tags = merge(line.astype(jnp.int32), dtags)
-        row_meta = dir_pack(
-            merge(act.new_state, dstate),
-            merge(act.new_owner, downer),
-            jnp.where(touched, rank_t, rank_u))
-        row_sharers = jnp.where(
-            touched[:, :, None],
-            jnp.sum(jnp.where(sw[:, :, :, None],
-                              act.new_sharers[None, :, None, :],
-                              jnp.uint64(0)), axis=1, dtype=jnp.uint64),
-            dsharers)
-
-        arA = jnp.arange(A)[:, None]
-        arW = jnp.arange(W)[:, None, None]
+        # ---- apply directory entry updates: single-way scatters.  The
+        # way-slot election guarantees winners hold distinct
+        # (home, dset, way) slots this round, so no two scatters collide;
+        # replacement recency is the scattered round stamp (timestamp LRU,
+        # like engine/cache.py — the old code maintained rank permutations
+        # with dense [T, T, A] merges).
+        fidx_w = jnp.where(win, fidx, jnp.int32(2**30))
+        arW = jnp.arange(W)[:, None]
         state = state._replace(
-            dir_tags=state.dir_tags.at[arA, home_w[None, :],
-                                       dset[None, :]].set(
-                row_tags.T, mode="drop"),
-            dir_meta=state.dir_meta.at[arA, home_w[None, :],
-                                       dset[None, :]].set(
-                row_meta.T, mode="drop"),
+            dir_tags=state.dir_tags.at[way, fidx_w].set(
+                line.astype(jnp.int32), mode="drop"),
+            dir_meta=state.dir_meta.at[way, fidx_w].set(
+                dir_pack(act.new_state, act.new_owner), mode="drop"),
+            dir_stamp=state.dir_stamp.at[way, fidx_w].set(
+                state.round_ctr, mode="drop"),
             dir_sharers=state.dir_sharers.at[
-                arW, arA[None], home_w[None, None, :],
-                dset[None, None, :]].set(
-                row_sharers.transpose(2, 1, 0), mode="drop"),
+                arW, way[None, :], fidx_w[None, :]].set(
+                act.new_sharers.T, mode="drop"),
         )
 
         # ---- coherence-driven cache-state changes, one single-pass sweep
@@ -753,7 +744,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                                  jnp.where(granted_e, E, S)).astype(
                                      jnp.int32)
             fd = cachemod.fill(state.l1d, line, l1_state, win & ~is_if,
-                               params.l1d.num_sets, params.l1d.replacement)
+                               params.l1d.num_sets, params.l1d.replacement,
+                               rstamp)
             state = state._replace(l1d=fd.cache)
             # L1 victims report back to their slice: dirty ones flush data
             # into the slice (entry -> O), clean drops clear sharer bits.
@@ -762,14 +754,13 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             # path, so no latency/link-contention charge) — it lands in
             # the slice, not DRAM.
             victim_dirty = win & ~is_if & (fd.victim_state == M)
-            oh_vhome = None   # dram_writes never home-bins L1->slice WBs
             state = _sh_l1_evict_notify(
                 params, state, rows, fd.victim_tag, fd.victim_state,
                 win & ~is_if & (fd.victim_state != I))
             fi = cachemod.fill(state.l1i, line,
                                jnp.full(T, S, dtype=jnp.int32),
                                win & is_if, params.l1i.num_sets,
-                               params.l1i.replacement)
+                               params.l1i.replacement, rstamp)
             state = state._replace(l1i=fi.cache)
             state = _sh_l1_evict_notify(
                 params, state, rows, fi.victim_tag, fi.victim_state,
@@ -778,15 +769,16 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             f2 = cachemod.fill(state.l2, line,
                                jnp.where(is_ex, M, S).astype(jnp.int32),
                                win, params.l2.num_sets,
-                               params.l2.replacement)
+                               params.l2.replacement, rstamp)
             state = state._replace(l2=f2.cache)
             victim_dirty = win & ((f2.victim_state == M)
                                   | (f2.victim_state == O))
             victim_live = win & (f2.victim_state != I)
             victim_home = dram_site_of_line(params, f2.victim_tag)
-            oh_vhome = _oh(victim_home, T)
-            state = state._replace(dram_free_at=state.dram_free_at + _binsum(
-                oh_vhome, victim_dirty, dram_service_ps))
+            state = state._replace(
+                dram_free_at=state.dram_free_at.at[
+                    jnp.where(victim_dirty, victim_home, T)].add(
+                    dram_service_ps, mode="drop"))
             # An evicted-from-L2 line also leaves L1 (inclusive hierarchy,
             # reference l2_cache_cntlr invalidation of L1 on eviction).
             state = state._replace(l1d=cachemod.invalidate_by_value(
@@ -802,12 +794,12 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             fd = cachemod.fill(state.l1d, line,
                                jnp.where(is_ex, M, S).astype(jnp.int32),
                                win & ~is_if, params.l1d.num_sets,
-                               params.l1d.replacement)
+                               params.l1d.replacement, rstamp)
             state = state._replace(l1d=fd.cache)
             fi = cachemod.fill(state.l1i, line,
                                jnp.full(T, S, dtype=jnp.int32),
                                win & is_if, params.l1i.num_sets,
-                               params.l1i.replacement)
+                               params.l1i.replacement, rstamp)
             state = state._replace(l1i=fi.cache)
 
         # ---- counters (all home-binned tallies via dense one-hot sums)
@@ -821,47 +813,67 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         kcnt = kcnt_inv + jnp.sum(vic_bool, axis=1).astype(jnp.int64)
         inv_count = jnp.sum(jnp.where(oh_sr, kcnt[:, None], 0), axis=0)
         c = state.counters
+        # Home-binned tallies ride ONE scatter-add of a stacked [T, 9+]
+        # delta matrix (the old per-counter dense [T, T] one-hot sums were
+        # O(T^2) each); rows with no work contribute zero deltas, so no
+        # mask is needed.
+        b = lambda m: m.astype(jnp.int64)
+        home_cols = [
+            b(win & ~is_ex),                          # dir_sh_req
+            b(win & is_ex),                           # dir_ex_req
+            inv_count,                                # dir_invalidations
+            b(owner_leg | evict_m | evict_o),         # dir_writebacks
+            b(owner_leg & ~act.dram_write),           # dir_forwards
+            b(evicting),                              # dir_evictions
+            b(win) + inv_count,                       # net_mem_pkts @home
+            jnp.where(win, flits_data, 0)
+            + inv_count * flits_req,                  # net_mem_flits @home
+            b(alloc_defer | fan_defer | ow_defer),    # dir_deferrals
+        ]
+        if params.shared_l2:
+            # Slice accesses/misses are accounted at the home tile here
+            # (the local kernel never sees an L2).
+            home_cols += [b(win), b(win & ~hit)]      # l2_access, l2_miss
+        hstack = jnp.stack(home_cols, axis=1)
+        hb = jnp.zeros((T, hstack.shape[1]), dtype=jnp.int64).at[
+            home].add(hstack)
+        # DRAM-site-binned tallies (+ the victim line's home controller
+        # for dirty private-L2 victim writebacks).
+        dstack = jnp.stack([b(need_read), b(dram_wb)], axis=1)
+        db = jnp.zeros((T, 2), dtype=jnp.int64).at[dsite].add(dstack)
+        if params.shared_l2:
+            # A dirty L1 victim flushes into the SLICE (its WB packet is
+            # counted below), not DRAM.
+            vic_wr = 0
+        else:
+            vic_wr = jnp.zeros(T, dtype=jnp.int64).at[
+                victim_home].add(b(victim_dirty))
         c = c._replace(
-            dir_sh_req=c.dir_sh_req + _binsum(oh_home, win & ~is_ex, 1),
-            dir_ex_req=c.dir_ex_req + _binsum(oh_home, win & is_ex, 1),
-            dir_invalidations=c.dir_invalidations
-            + _binsum(oh_home, inv_count > 0, inv_count),
-            dir_writebacks=c.dir_writebacks
-            + _binsum(oh_home, owner_leg | evict_m | evict_o, 1),
-            dir_forwards=c.dir_forwards
-            + _binsum(oh_home, owner_leg & ~act.dram_write, 1),
-            dir_evictions=c.dir_evictions + _binsum(oh_home, evicting, 1),
-            dram_reads=c.dram_reads + _binsum(oh_dsite, need_read, 1),
-            # Under shared L2 a dirty L1 victim flushes into the SLICE
-            # (victim_dirty counts its WB packet below), not DRAM.
-            dram_writes=c.dram_writes
-            + _binsum(oh_dsite, dram_wb, 1)
-            + (0 if params.shared_l2
-               else _binsum(oh_vhome, victim_dirty, 1)),
-            # Shared L2: slice accesses/misses are accounted at the home
-            # tile here (the local kernel never sees an L2).
-            l2_access=c.l2_access + (_binsum(oh_home, win, 1)
-                                     if params.shared_l2 else 0),
-            l2_miss=c.l2_miss + (_binsum(oh_home, win & ~hit, 1)
-                                 if params.shared_l2 else 0),
+            dir_sh_req=c.dir_sh_req + hb[:, 0],
+            dir_ex_req=c.dir_ex_req + hb[:, 1],
+            dir_invalidations=c.dir_invalidations + hb[:, 2],
+            dir_writebacks=c.dir_writebacks + hb[:, 3],
+            dir_forwards=c.dir_forwards + hb[:, 4],
+            dir_evictions=c.dir_evictions + hb[:, 5],
+            dram_reads=c.dram_reads + db[:, 0],
+            dram_writes=c.dram_writes + db[:, 1] + vic_wr,
+            l2_access=c.l2_access + (hb[:, 9] if params.shared_l2 else 0),
+            l2_miss=c.l2_miss + (hb[:, 10] if params.shared_l2 else 0),
             net_mem_pkts=c.net_mem_pkts
             + jnp.where(win, 1, 0)                    # request
             + jnp.where(victim_dirty, 1, 0)           # victim WB data
             # reply + INV_REQ traffic accounted at the home tile
-            + _binsum(oh_home, win, 1)
-            + _binsum(oh_home, inv_count > 0, inv_count),
+            + hb[:, 6],
             net_mem_flits=c.net_mem_flits
             + jnp.where(win, flits_req, 0)
             + jnp.where(victim_dirty, flits_data, 0)
-            + _binsum(oh_home, win, flits_data)
-            + _binsum(oh_home, inv_count > 0, inv_count * flits_req),
+            + hb[:, 7],
             net_link_wait_ps=c.net_link_wait_ps + link_wait,
             # Deferral events this round: way-slot collisions + fan-out
             # budget overflow + owner-delivery budget overflow (a request
             # deferred in N rounds counts N times; end-of-pass saturation
             # is counted separately below).
-            dir_deferrals=c.dir_deferrals
-            + _binsum(oh_home, alloc_defer | fan_defer | ow_defer, 1),
+            dir_deferrals=c.dir_deferrals + hb[:, 8],
         )
         state = state._replace(counters=c)
 
@@ -929,6 +941,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 line_floor,
                 jnp.where(ftbl_line[hidx] == line, ftbl_t[hidx], 0))
         resolved = resolved | win
+        state = state._replace(round_ctr=state.round_ctr + 1)
         return _i + 1, state, resolved, line_floor
 
     # Early-exit conflict rounds: a round only runs while unresolved
@@ -948,7 +961,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     saturated = is_req & ~resolved
     c = state.counters
     state = state._replace(counters=c._replace(
-        dir_deferrals=c.dir_deferrals + _binsum(oh_home, saturated, 1)))
+        dir_deferrals=c.dir_deferrals.at[home].add(
+            saturated.astype(jnp.int64))))
     return state
 
 
@@ -966,9 +980,10 @@ class _VictimProbe:
         ndsets = params.directory.num_sets
         self.vhome = home_of_line(params, vtag)
         self.vdset = dir_set_of_line(params, vtag)
-        vfidx = (self.vhome * ndsets + self.vdset).astype(jnp.int32)
-        dtags = state.dir_tags.reshape(A, -1)[:, vfidx].T   # [T, A]
-        dmeta = state.dir_meta.reshape(A, -1)[:, vfidx].T
+        self.vfidx = (self.vhome * ndsets + self.vdset).astype(jnp.int32)
+        vfidx = self.vfidx
+        dtags = state.dir_tags[:, vfidx].T                  # [T, A]
+        dmeta = state.dir_meta[:, vfidx].T
         dstate = dir_meta_state(dmeta)
         match = (dtags == vtag[:, None].astype(jnp.int32)) \
             & (dstate != I) & valid[:, None]
@@ -981,7 +996,7 @@ class _VictimProbe:
         self.esharers = jnp.sum(
             jnp.where((jnp.arange(A, dtype=jnp.int32)[:, None]
                        == self.way[None, :])[None, :, :],
-                      state.dir_sharers.reshape(W, A, -1)[:, :, vfidx],
+                      state.dir_sharers[:, :, vfidx],
                       jnp.uint64(0)), axis=1, dtype=jnp.uint64).T  # [T, W]
         self.word = (tiles // 64).astype(jnp.int32)
         self.bit = jnp.uint64(1) << (tiles % 64).astype(jnp.uint64)
@@ -993,22 +1008,20 @@ class _VictimProbe:
 
     def set_meta(self, state: SimState, mask, new_state, new_owner):
         """Rewrite the matched entry's (state, owner) where ``mask``."""
-        T = mask.shape[0]
-        h = jnp.where(mask, self.vhome, T).astype(jnp.int32)
+        f = jnp.where(mask, self.vfidx, jnp.int32(2**30))
         return state._replace(
-            dir_meta=state.dir_meta.at[self.way, h, self.vdset].set(
-                dir_pack(new_state, new_owner,
-                         dir_meta_lru(self.meta_way)), mode="drop"))
+            dir_meta=state.dir_meta.at[self.way, f].set(
+                dir_pack(new_state, new_owner), mode="drop"))
 
     def clear_bit(self, state: SimState, mask):
         """Clear the dropping tile's sharer bit where ``mask`` (guarded
         commutative subtract — distinct sharers of one entry may clear in
         the same batch)."""
-        T = mask.shape[0]
-        h = jnp.where(mask & self.has_bit, self.vhome, T).astype(jnp.int32)
+        f = jnp.where(mask & self.has_bit, self.vfidx,
+                      jnp.int32(2**30))
         return state._replace(
             dir_sharers=state.dir_sharers.at[
-                self.word, self.way, h, self.vdset].add(
+                self.word, self.way, f].add(
                 jnp.uint64(0) - self.bit, mode="drop"))
 
 
@@ -1044,11 +1057,11 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
     state = p.set_meta(state, drop_m | ((drop_s | drop_o) & empty), I, -1)
     state = p.set_meta(state, drop_o & ~empty, S, -1)
     # M drop wipes the whole bitmap row (the owner was the only holder).
-    hm = jnp.where(drop_m, p.vhome, T).astype(jnp.int32)
+    fm = jnp.where(drop_m, p.vfidx, jnp.int32(2**30))
     arW = jnp.arange(W)[:, None]
     state = state._replace(
         dir_sharers=state.dir_sharers.at[
-            arW, p.way[None, :], hm[None, :], p.vdset[None, :]].set(
+            arW, p.way[None, :], fm[None, :]].set(
             jnp.zeros((W, T), dtype=jnp.uint64), mode="drop"))
     return p.clear_bit(state, drop_s | drop_o)
 
@@ -1348,10 +1361,8 @@ def resolve_join(params: SimParams, state: SimState) -> SimState:
     rows = jnp.arange(T)
     is_j = state.pend_kind == PEND_JOIN
     child = jnp.clip(state.pend_aux, 0, T - 1)
-    oh_ch = _oh(child, T)
-    child_done = jnp.sum(jnp.where(oh_ch, state.done[None, :], False),
-                         axis=1, dtype=jnp.int32) > 0
-    child_done_at = _sel(oh_ch, state.done_at)
+    child_done = state.done[child]
+    child_done_at = state.done_at[child]
     ok = is_j & child_done
     p_nu = _period(state, DVFSModule.NETWORK_USER)
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
@@ -1360,7 +1371,7 @@ def resolve_join(params: SimParams, state: SimState) -> SimState:
                             CTRL_BYTES, p_nu, params.mesh_width)
     from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
                               CTRL_BYTES, p_nu[mcp], params.mesh_width)
-    exit_at_mcp = child_done_at + _sel(oh_ch, to_mcp)
+    exit_at_mcp = child_done_at + to_mcp[child]
     completion = jnp.maximum(state.pend_issue + to_mcp, exit_at_mcp) \
         + from_mcp + cycle_ps
     state = state._replace(counters=state.counters._replace(
@@ -1392,18 +1403,37 @@ def _when_pending(kind: int, fn, params: SimParams,
 def resolve(params: SimParams, state: SimState) -> SimState:
     """One full cross-tile resolution pass.  resolve_cond runs before
     resolve_mutex so a freshly-woken waiter competes for its mutex
-    re-acquire in the same pass."""
-    state = resolve_memory(params, state)
-    state = _when_pending(PEND_RECV, resolve_recv, params, state)
-    state = _when_pending(PEND_SEND, resolve_send, params, state)
-    state = _when_pending(PEND_BARRIER, resolve_barrier, params, state)
-    # Cond resolution runs whenever waiters OR tokens are parked (a lost
-    # signal must still expire and ack its poster with no waiter around).
+    re-acquire in the same pass.
+
+    Two conditionals only — memory and one combined sync gate.  Each
+    ``lax.cond`` costs pass-through buffer copies of the whole state on
+    TPU, so per-kind gating (round 2's shape) paid ~7 state copies per
+    sub-round; the per-kind resolvers are no-ops on empty masks anyway.
+    """
     state = jax.lax.cond(
-        ((state.pend_kind == PEND_COND) | (state.pend_kind == PEND_CSIG)
-         | (state.pend_kind == PEND_CBC)).any(),
-        lambda s: resolve_cond(params, s), lambda s: s, state)
-    state = _when_pending(PEND_MUTEX, resolve_mutex, params, state)
-    state = _when_pending(PEND_JOIN, resolve_join, params, state)
-    state = _when_pending(PEND_START, resolve_start, params, state)
-    return state
+        ((state.pend_kind == PEND_SH_REQ) | (state.pend_kind == PEND_EX_REQ)
+         | (state.pend_kind == PEND_IFETCH)).any(),
+        lambda s: resolve_memory(params, s), lambda s: s, state)
+
+    def sync_pass(s: SimState) -> SimState:
+        if s.has_capi:
+            # Traces with no CAPI traffic carry zero-size channel arrays
+            # (see make_state) — these resolvers would index them, and no
+            # tile can park on RECV/SEND without CAPI events in the trace.
+            s = _when_pending(PEND_RECV, resolve_recv, params, s)
+            s = _when_pending(PEND_SEND, resolve_send, params, s)
+        s = _when_pending(PEND_BARRIER, resolve_barrier, params, s)
+        # Cond resolution runs whenever waiters OR tokens are parked (a
+        # lost signal must still expire and ack its poster with no waiter
+        # around).
+        s = jax.lax.cond(
+            ((s.pend_kind == PEND_COND) | (s.pend_kind == PEND_CSIG)
+             | (s.pend_kind == PEND_CBC)).any(),
+            lambda x: resolve_cond(params, x), lambda x: x, s)
+        s = _when_pending(PEND_MUTEX, resolve_mutex, params, s)
+        s = _when_pending(PEND_JOIN, resolve_join, params, s)
+        s = _when_pending(PEND_START, resolve_start, params, s)
+        return s
+
+    any_sync = (state.pend_kind >= PEND_RECV).any()   # every non-memory kind
+    return jax.lax.cond(any_sync, sync_pass, lambda s: s, state)

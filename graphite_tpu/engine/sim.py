@@ -220,7 +220,13 @@ class Simulator:
                 f"{params.num_tiles}")
         self.params = params
         self.trace = TraceArrays.from_trace(trace)
-        self.state = make_state(params)
+        # CAPI channel state is O(T^2); only allocate it when the trace
+        # actually messages (scan once, host-side).
+        from graphite_tpu.isa import EventOp
+        ops = np.asarray(trace.ops)
+        has_capi = bool(((ops == int(EventOp.SEND))
+                         | (ops == int(EventOp.RECV))).any())
+        self.state = make_state(params, has_capi=has_capi)
         self.steps = 0
         self.host_seconds = 0.0
 

@@ -140,14 +140,15 @@ class TraceArrays(NamedTuple):
 
 _DIR_OWNER_BITS = 13   # owner+1, supports up to 8191 tiles
 _DIR_OWNER_SHIFT = 3
-_DIR_LRU_SHIFT = _DIR_OWNER_SHIFT + _DIR_OWNER_BITS
 
 
-def dir_pack(state, owner, lru):
-    """Pack directory-entry (state, owner tile, LRU rank) into one int32."""
+def dir_pack(state, owner, lru=0):
+    """Pack directory-entry (state, owner tile) into one int32.  The
+    replacement stamp lives in the separate ``dir_stamp`` array (see
+    SimState); the legacy ``lru`` argument is accepted and ignored."""
+    del lru
     return (jnp.asarray(state, jnp.int32)
-            | ((jnp.asarray(owner, jnp.int32) + 1) << _DIR_OWNER_SHIFT)
-            | (jnp.asarray(lru, jnp.int32) << _DIR_LRU_SHIFT))
+            | ((jnp.asarray(owner, jnp.int32) + 1) << _DIR_OWNER_SHIFT))
 
 
 def dir_meta_state(meta):
@@ -156,10 +157,6 @@ def dir_meta_state(meta):
 
 def dir_meta_owner(meta):
     return ((meta >> _DIR_OWNER_SHIFT) & ((1 << _DIR_OWNER_BITS) - 1)) - 1
-
-
-def dir_meta_lru(meta):
-    return meta >> _DIR_LRU_SHIFT
 
 
 class SimState(NamedTuple):
@@ -196,13 +193,19 @@ class SimState(NamedTuple):
 
     # -- directory slices (home-tile-indexed; reference: directory_cache.cc)
     # Entry metadata is packed into one int32 word (see dir_pack/
-    # dir_meta_*): the engine is HBM-bound and three separate int32 arrays
-    # tripled the per-round directory traffic.  Small structural axes
-    # (assoc, bitmap words) lead so the minor dims stay (8,128)-tile-sized.
-    dir_tags: jnp.ndarray     # [dassoc, T, dsets] int32 line id
-    dir_meta: jnp.ndarray     # [dassoc, T, dsets] int32 packed
-    #   (state bits 0-2 | owner+1 bits 3-15 | lru bits 16+)
-    dir_sharers: jnp.ndarray  # [W, dassoc, T, dsets] uint64 sharer bitmaps
+    # dir_meta_*): the engine is HBM-bound and separate state/owner arrays
+    # doubled the per-round directory traffic.  The (tile, set) axes are
+    # stored PRE-FLATTENED — every access indexes by the flat
+    # home*ndsets + dset id, and a [.., T, dsets] layout forced XLA to
+    # materialize a full-array reshape copy per conflict round (profiled
+    # at ~4.5 ms per round on the 512 MB 1024-tile sharer bitmap).
+    dir_tags: jnp.ndarray     # [dassoc, T*dsets] int32 line id
+    dir_meta: jnp.ndarray     # [dassoc, T*dsets] int32 packed
+    #   (state bits 0-2 | owner+1 bits 3-15)
+    dir_stamp: jnp.ndarray    # [dassoc, T*dsets] int32 replacement stamp
+    #   (monotone access counter; victim = min-stamp way — true LRU, in
+    #   scatter-friendly timestamp form like engine/cache.py)
+    dir_sharers: jnp.ndarray  # [W, dassoc, T*dsets] uint64 sharer bitmaps
 
     # -- iocoom load/store queues (reference: iocoom_core_model.cc:78-;
     # completion-time rings — a load/store miss parks the tile only until
@@ -255,12 +258,24 @@ class SimState(NamedTuple):
     #   (the progress trace; [1, T] dummy when disabled)
 
     # -- user-network channels (CAPI; reference: common/user/capi.cc)
+    # [T, T]-shaped, so allocated only when the trace actually uses CAPI
+    # (zero-size dummies otherwise — see make_state(has_capi); a 1024-tile
+    # radix run must not carry O(T^2) channel state it never touches)
     ch_sent: jnp.ndarray       # [T, T] int32 messages sent src->dst
     ch_recvd: jnp.ndarray      # [T, T] int32 messages consumed
     ch_time: jnp.ndarray       # [D, T, T] int64 arrival-time ring buffer
     #   (slot axis leads — see the directory layout note)
 
+    # -- engine round counter (stamp source for the timestamp-LRU caches;
+    # bumped once per local round and per resolve conflict round)
+    round_ctr: jnp.ndarray     # [] int32
+
     counters: Counters
+
+    @property
+    def has_capi(self) -> bool:
+        """Static: were CAPI channel arrays allocated for this run?"""
+        return self.ch_sent.size > 0
 
 
 def init_periods(params: SimParams) -> np.ndarray:
@@ -274,10 +289,8 @@ def _dummy_cache(num_tiles: int) -> cachemod.CacheArrays:
     """Placeholder private-L2 arrays for shared-L2 protocols (the slice
     lives in the directory arrays; a full-size private L2 would waste HBM
     at scale).  Never probed — core/resolve gate on params.shared_l2."""
-    shape = (1, num_tiles, 1)
-    z = jnp.zeros(shape, dtype=jnp.int32)
     return cachemod.CacheArrays(
-        tags=z, meta=cachemod.pack_meta(z, z),
+        word=jnp.zeros((1, num_tiles, 1), dtype=jnp.int64),
         rr_ptr=jnp.zeros((num_tiles, 1), dtype=jnp.int32))
 
 
@@ -293,7 +306,8 @@ def _nsamp(params: SimParams) -> int:
 def make_state(params: SimParams,
                max_mutexes: int = 64,
                max_barriers: int = 16,
-               channel_depth: int = 0) -> SimState:
+               channel_depth: int = 0,
+               has_capi: bool = True) -> SimState:
     T = params.num_tiles
     if T > (1 << _DIR_OWNER_BITS) - 2:
         raise ValueError(
@@ -301,7 +315,8 @@ def make_state(params: SimParams,
             f"({(1 << _DIR_OWNER_BITS) - 2} max); widen _DIR_OWNER_BITS")
     if channel_depth <= 0:
         channel_depth = params.channel_depth
-    d_shape = (params.directory.associativity, T, params.directory.num_sets)
+    d_shape = (params.directory.associativity,
+               T * params.directory.num_sets)
     W = (T + 63) // 64  # sharer bitmap words (full_map)
     return SimState(
         clock=jnp.zeros(T, dtype=jnp.int64),
@@ -322,10 +337,8 @@ def make_state(params: SimParams,
         dir_tags=jnp.zeros(d_shape, dtype=jnp.int32),
         dir_meta=dir_pack(
             jnp.zeros(d_shape, dtype=jnp.int32),
-            jnp.full(d_shape, -1, dtype=jnp.int32),
-            jnp.broadcast_to(
-                jnp.arange(params.directory.associativity,
-                           dtype=jnp.int32)[:, None, None], d_shape)),
+            jnp.full(d_shape, -1, dtype=jnp.int32)),
+        dir_stamp=jnp.zeros(d_shape, dtype=jnp.int32),
         dir_sharers=jnp.zeros((W,) + d_shape, dtype=jnp.uint64),
         lq_ready=jnp.zeros((params.core.load_queue_entries, T),
                            dtype=jnp.int64),
@@ -349,8 +362,10 @@ def make_state(params: SimParams,
         stat_icount=jnp.zeros(
             (_nsamp(params) if params.progress_enabled else 1, T),
             dtype=jnp.int64),
-        ch_sent=jnp.zeros((T, T), dtype=jnp.int32),
-        ch_recvd=jnp.zeros((T, T), dtype=jnp.int32),
-        ch_time=jnp.zeros((channel_depth, T, T), dtype=jnp.int64),
+        ch_sent=jnp.zeros((T, T) if has_capi else (0, 0), dtype=jnp.int32),
+        ch_recvd=jnp.zeros((T, T) if has_capi else (0, 0), dtype=jnp.int32),
+        ch_time=jnp.zeros((channel_depth, T, T) if has_capi else (0, 0, 0),
+                          dtype=jnp.int64),
+        round_ctr=jnp.int32(0),
         counters=make_counters(T),
     )

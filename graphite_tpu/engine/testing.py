@@ -21,14 +21,12 @@ def warm_cache(cache: cachemod.CacheArrays, cp: CacheParams, tile: int,
     for line in lines:
         sidx = int(line) % cp.num_sets
         # find a free way (or overwrite way 0)
-        ways = cachemod.meta_state(cache.meta[:, tile, sidx])
+        ways = cachemod.word_state(cache.word[:, tile, sidx])
         free = int(jnp.argmax(ways == cachemod.I)) \
             if bool((ways == cachemod.I).any()) else 0
-        lru = int(cachemod.meta_lru(cache.meta[free, tile, sidx]))
         cache = cache._replace(
-            tags=cache.tags.at[free, tile, sidx].set(int(line)),
-            meta=cache.meta.at[free, tile, sidx].set(
-                int(cachemod.pack_meta(state_val, lru))),
+            word=cache.word.at[free, tile, sidx].set(
+                int(cachemod.pack_word(int(line), 0, state_val))),
         )
     return cache
 

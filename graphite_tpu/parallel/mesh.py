@@ -42,14 +42,20 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
 # structural axis that happens to equal the tile count (e.g. channel_depth
 # == num_tiles).
 _TILE_AXIS_BY_FIELD = {
-    "tags": 1, "meta": 1,            # CacheArrays [A, T, sets] / trace
-    "dir_tags": 1, "dir_meta": 1,    # [A, T, dsets]
-    "dir_sharers": 2,                # [W, A, T, dsets]
+    "word": 1, "meta": 1,            # CacheArrays [A, T, sets] / trace
+    "dir_tags": 1, "dir_meta": 1,    # [A, T*dsets] (tile-major flat)
+    "dir_stamp": 1,
+    "dir_sharers": 2,                # [W, A, T*dsets]
     "ch_time": 1,                    # [D, T, T]
     "lq_ready": 1, "sq_ready": 1,    # [entries, T]
     "link_free_mem": 1,              # [NUM_DIRS, T]
     "stat_icount": 1,                # [S, T] progress-trace snapshots
 }
+
+# Fields whose tile axis is FLATTENED with a per-tile structural axis
+# (directory sets): tile-major, so an even split over the flat axis is an
+# even split over tiles.
+_TILE_MAJOR_FLAT = {"dir_tags", "dir_meta", "dir_stamp", "dir_sharers"}
 
 
 def tile_sharding(mesh: Mesh, num_tiles: int):
@@ -59,7 +65,10 @@ def tile_sharding(mesh: Mesh, num_tiles: int):
     def spec_for(name: str, leaf: Any):
         shape = np.shape(leaf)
         ax = _TILE_AXIS_BY_FIELD.get(name, 0)
-        if len(shape) > ax and shape[ax] == num_tiles:
+        ok = len(shape) > ax and (
+            shape[ax] == num_tiles
+            or (name in _TILE_MAJOR_FLAT and shape[ax] % num_tiles == 0))
+        if ok:
             return NamedSharding(mesh, P(*([None] * ax + [TILE_AXIS])))
         return NamedSharding(mesh, P())
 

@@ -48,6 +48,27 @@ def _positive(value: int, path: str) -> int:
     return value
 
 
+def _nonneg(value: int, path: str) -> int:
+    if value < 0:
+        raise ConfigError(f"{path} must be >= 0: {value}")
+    return value
+
+
+# Engine stamp-allocation stride per round (see engine/core.STAMP_STRIDE;
+# defined here so the config validator needn't import the engine): block
+# windows use stamp offsets 0..K-1, the general slot and resolve fills use
+# the top two, so K is capped at STRIDE - 2.
+STAMP_STRIDE = 64
+
+
+def _block_events(value: int) -> int:
+    if not 0 <= value <= STAMP_STRIDE - 2:
+        raise ConfigError(
+            f"tpu/block_events must be in [0, {STAMP_STRIDE - 2}] "
+            f"(stamp-stride limit): {value}")
+    return value
+
+
 def _ceil_pow2(x: int) -> int:
     return 1 << _ceil_log2(x)
 
@@ -354,6 +375,10 @@ class SimParams:
     max_stat_samples: int
 
     # TPU engine knobs
+    # Window width of the block-retirement fast path (events gathered per
+    # tile per local round; 0 disables it — every event then goes through
+    # the general one-event slot, the round-2 engine shape).
+    block_events: int
     max_events_per_quantum: int
     directory_conflict_rounds: int
     rounds_per_quantum: int
@@ -526,6 +551,7 @@ class SimParams:
                 (cfg.get_int("progress_trace/interval")
                  if cfg.get_bool("progress_trace/enabled") else 1 << 40)))),
             max_stat_samples=cfg.get_int("tpu/max_stat_samples", 1024),
+            block_events=_block_events(cfg.get_int("tpu/block_events", 16)),
             max_events_per_quantum=cfg.get_int("tpu/max_events_per_quantum"),
             directory_conflict_rounds=cfg.get_int("tpu/directory_conflict_rounds"),
             rounds_per_quantum=cfg.get_int("tpu/rounds_per_quantum", 4),

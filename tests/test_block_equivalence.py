@@ -1,0 +1,68 @@
+"""Block-retirement fast path == one-event-per-slot engine.
+
+The [T, K] window phase (engine/core._block_retire) is a pure accelerator:
+every event it retires must land the exact state the general slot would
+have produced event-by-event.  These tests run identical traces with
+``tpu/block_events`` 0 (fast path off — the round-2 engine shape) and on,
+and require bit-identical clocks, counters, and cache-derived outcomes.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+pytestmark = pytest.mark.quick
+
+
+def _run(trace, num_tiles, block_events, **over):
+    cfg = load_config()
+    cfg.set("general/total_cores", num_tiles)
+    cfg.set("tpu/block_events", block_events)
+    for k, v in over.items():
+        cfg.set(k, v)
+    params = SimParams.from_config(cfg)
+    sim = Simulator(params, trace)
+    return sim.run(max_steps=64)
+
+
+def _assert_equal(a, b):
+    assert a.completion_time_ps == b.completion_time_ps
+    np.testing.assert_array_equal(a.clock, b.clock)
+    assert a.done.all() and b.done.all()
+    for k in a.counters:
+        np.testing.assert_array_equal(a.counters[k], b.counters[k], k)
+
+
+@pytest.mark.parametrize("block_events", [4, 16])
+def test_radix_equivalent(block_events):
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=64, radix=16, seed=3)
+    base = _run(trace, 8, 0)
+    fast = _run(trace, 8, block_events)
+    _assert_equal(base, fast)
+
+
+def test_fft_equivalent():
+    trace = synth.gen_fft(num_tiles=8, points_per_tile=64)
+    _assert_equal(_run(trace, 8, 0), _run(trace, 8, 16))
+
+
+def test_mixed_sync_equivalent():
+    """Barriers + mutexes + stalls interleaved with memory traffic."""
+    trace = synth.gen_lock_contention(num_tiles=8, acquisitions=12)
+    _assert_equal(_run(trace, 8, 0), _run(trace, 8, 16))
+
+
+def test_mosi_equivalent():
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=48, radix=16, seed=9)
+    over = {"caching_protocol/type": "pr_l1_pr_l2_dram_directory_mosi"}
+    _assert_equal(_run(trace, 8, 0, **over), _run(trace, 8, 16, **over))
+
+
+def test_shared_l2_equivalent():
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=48, radix=16, seed=11)
+    over = {"caching_protocol/type": "pr_l1_sh_l2_mesi"}
+    _assert_equal(_run(trace, 8, 0, **over), _run(trace, 8, 16, **over))

@@ -1,0 +1,65 @@
+"""Per-phase device-time breakdown (VERDICT r2 next-round item 1a).
+
+Times local_advance, resolve, and the fused megastep separately on the
+attached backend at several tile counts, printing one JSON line per
+config.  Usage: python tools/profile_phases.py [tiles ...]
+"""
+
+import json
+import sys
+import time
+
+import jax
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine import quantum
+from graphite_tpu.engine.core import local_advance
+from graphite_tpu.engine.resolve import resolve
+from graphite_tpu.engine.state import TraceArrays, make_state
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+
+def bench_fn(fn, *args, iters=8):
+    out = fn(*args)          # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    tiles = [int(a) for a in sys.argv[1:]] or [64, 256, 1024]
+    for T in tiles:
+        cfg = load_config()
+        cfg.set("general/total_cores", T)
+        params = SimParams.from_config(cfg)
+        trace = synth.gen_radix(num_tiles=T, keys_per_tile=2048, seed=1)
+        ta = TraceArrays.from_trace(trace)
+        state = make_state(params)
+
+        la = jax.jit(lambda s: local_advance(params, s, ta))
+        rs = jax.jit(lambda s: resolve(params, s))
+        ms = jax.jit(lambda s: quantum.megastep(params, s, ta))
+
+        t_la = bench_fn(la, state)
+        # resolve on the post-local state (has parked requests)
+        state2 = jax.block_until_ready(la(state))
+        t_rs = bench_fn(rs, state2)
+        t_ms = bench_fn(ms, state)
+
+        # events retired in the first local_advance
+        ev = int(jax.device_get(state2.cursor.sum()))
+        print(json.dumps({
+            "tiles": T,
+            "local_advance_s": round(t_la, 5),
+            "resolve_s": round(t_rs, 5),
+            "megastep_s": round(t_ms, 5),
+            "events_first_la": ev,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Fused per-round device-cost breakdown on the attached backend.
+
+Times block retirement, the complex slot, and resolve separately (each
+iterated inside one jitted fori_loop on a mid-run state) — the numbers
+that matter for the engine's rounds/sec ceiling.
+Usage: python tools/profile_round.py [tiles] [iters]
+"""
+
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine import resolve as rs
+from graphite_tpu.engine.core import _block_retire, _complex_slot
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+
+def fused(fn, state, iters):
+    @jax.jit
+    def loop(s):
+        return jax.lax.fori_loop(0, iters, lambda i, x: fn(x), s)
+
+    jax.block_until_ready(loop(state))
+    t0 = time.perf_counter()
+    out = loop(state)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e6
+
+
+def main():
+    T = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    cfg = load_config()
+    cfg.set("general/total_cores", T)
+    params = SimParams.from_config(cfg)
+    trace = synth.gen_radix(num_tiles=T, keys_per_tile=256, seed=1)
+    sim = Simulator(params, trace)
+    sim.run(max_steps=4)   # mid-run state: warm caches, parked requests
+    state, ta = sim.state, sim.trace
+
+    for name, fn in [
+        ("block", lambda s: _block_retire(params, s, ta)),
+        ("complex", lambda s: _complex_slot(params, s, ta)),
+        ("resolve_memory", lambda s: rs.resolve_memory(params, s)),
+        ("resolve_all", lambda s: rs.resolve(params, s)),
+    ]:
+        us = fused(fn, state, iters)
+        print(f"T={T} {name}: {us:.0f} us/round", flush=True)
+
+
+if __name__ == "__main__":
+    main()
