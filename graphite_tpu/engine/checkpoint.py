@@ -24,7 +24,8 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 10  # v4: packed int32 cache/dir metadata layout;
+_SCHEMA_VERSION = 11  # v4: packed int32 cache/dir metadata layout;
+#   v11: [W*A, F] flat sharer planes;
 #   v10: packed int64 cache words (timestamp LRU), dir_stamp, round_ctr,
 #        optional (zero-size) CAPI channel arrays;
 #   v9: ROI flag + statistics/progress sample ring;
@@ -58,11 +59,10 @@ def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:
     The params must describe the same simulation (tile count, cache
     geometry, ...) that produced the checkpoint; shapes are verified.
     """
-    with np.load(path) as _probe:
-        saved_capi = _probe["ch_sent"].size > 0
-    template = make_state(params, has_capi=saved_capi)
-    arrays, treedef = _flatten_with_paths(template)
     with np.load(path) as z:
+        saved_capi = z["ch_sent"].size > 0
+        template = make_state(params, has_capi=saved_capi)
+        arrays, treedef = _flatten_with_paths(template)
         if int(z["__meta_schema"]) != _SCHEMA_VERSION:
             raise ValueError(
                 f"checkpoint schema {int(z['__meta_schema'])} != "

@@ -327,7 +327,11 @@ def _block_retire(params: SimParams, st: SimState,
             new_word = cachemod.pack_word(
                 line.astype(jnp.int32), stamp, fill_state)
             if cp.replacement == "round_robin":
-                adv = act & ~probe.hit & ~has_inv
+                # Pointer advances on EVERY non-resident install (even
+                # into an invalid way) — must match cachemod.fill, the
+                # complex-slot/resolve path, or victim choices diverge
+                # between block_events settings.
+                adv = act & ~probe.hit
                 rr = jnp.take_along_axis(cache.rr_ptr, probe.set_idx,
                                          axis=1)
                 A = cache.word.shape[0]

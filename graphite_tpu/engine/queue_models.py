@@ -38,31 +38,12 @@ class FcfsResult(NamedTuple):
     free_at: jnp.ndarray   # [R] int64 updated per-resource horizon
 
 
-def _segmented_running_max(x: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
-    """Running max of ``x`` that restarts at every True in ``seg_start``.
-
-    Hillis-Steele doubling over (value, is_start) pairs: log2(K) rounds of
-    shift + elementwise combine.  Written with explicit shifts rather than
-    ``lax.associative_scan``/``jnp.cumsum`` because XLA:TPU lowers int64
-    scans to reduce-windows whose scoped-VMEM footprint blows past the
-    16 MB limit at K >= 256; the doubling form stays elementwise.
-    """
-    neg = jnp.int64(-(2**62))
-    v, st = x, seg_start
-    d = 1
-    K = x.shape[0]
-    while d < K:
-        pv = jnp.concatenate([jnp.full((d,), neg, x.dtype), v[:-d]])
-        ps = jnp.concatenate([jnp.ones((d,), bool), st[:-d]])
-        v = jnp.where(st, v, jnp.maximum(v, pv))
-        st = st | ps
-        d *= 2
-    return v
-
-
 def _cumsum_doubling(x: jnp.ndarray) -> jnp.ndarray:
-    """Inclusive prefix sum via doubling (same TPU-lowering rationale as
-    ``_segmented_running_max``)."""
+    """Inclusive prefix sum via doubling: log2(K) rounds of shift + add.
+    Written with explicit shifts rather than ``lax.associative_scan``/
+    ``jnp.cumsum`` because XLA:TPU lowers int64 scans to reduce-windows
+    whose scoped-VMEM footprint blows past the 16 MB limit at K >= 256;
+    the doubling form stays elementwise."""
     v = x
     d = 1
     K = x.shape[0]

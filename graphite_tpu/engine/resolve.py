@@ -52,6 +52,20 @@ CTRL_BYTES = 8
 J_OWN = 8
 
 
+def _home_fold(line: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Line -> home slot in [0, n): round-robin over consecutive lines
+    (streams still spread like the reference's low-bit interleaving,
+    address_home_lookup.cc), but with the bits above the slot index
+    XOR-folded in first — a plain ``line % n`` sends every
+    power-of-two-strided per-tile region (e.g. tile t's block at
+    base + t * 2^k) to ONE home, serializing all T cold misses through a
+    single directory set's way election (observed: 1024 tiles, 98k
+    deferrals, one 48 us DRAM horizon)."""
+    bits = max(n.bit_length() - 1, 1)
+    x = line ^ (line >> bits) ^ (line >> (2 * bits)) ^ (line >> (3 * bits))
+    return (x % n).astype(jnp.int32)
+
+
 def home_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
     """Home tile serving a line's coherence requests.
 
@@ -62,17 +76,17 @@ def home_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
     slice, lines interleave across all of them (reference:
     pr_l1_sh_l2_msi/l2_cache_hash_fn.cc)."""
     if params.shared_l2:
-        return (line % params.num_tiles).astype(jnp.int32)
-    n = params.dram.num_controllers
-    return ((line % n) * params.dram.controller_home_stride).astype(jnp.int32)
+        return _home_fold(line, params.num_tiles)
+    return _home_fold(line, params.dram.num_controllers) \
+        * params.dram.controller_home_stride
 
 
 def dram_site_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
     """Memory-controller tile for a line (== home_of_line for private-L2
     protocols; under shared L2 the slice home and the DRAM controller can
     be different tiles, adding a slice->controller leg)."""
-    n = params.dram.num_controllers
-    return ((line % n) * params.dram.controller_home_stride).astype(jnp.int32)
+    return _home_fold(line, params.dram.num_controllers) \
+        * params.dram.controller_home_stride
 
 
 def dir_set_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
@@ -137,7 +151,7 @@ def _elect(active, packed, idx, size):
 
 
 def _grouped_rank(group: jnp.ndarray, key: jnp.ndarray,
-                  active: jnp.ndarray, sink: int) -> jnp.ndarray:
+                  active: jnp.ndarray) -> jnp.ndarray:
     """FCFS rank of each active row within its ``group``, ordered by
     ``key``, as ONE dense [R, R] masked compare-and-sum.
 
@@ -150,7 +164,6 @@ def _grouped_rank(group: jnp.ndarray, key: jnp.ndarray,
     target tile — without the tiebreak they'd collide on one slot).
     Inactive rows get rank 0.
     """
-    del sink
     R = key.shape[0]
     idx = jnp.arange(R, dtype=jnp.int32)
     g = group.astype(jnp.int32)
@@ -193,8 +206,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     mis-times a request.
     """
     T = params.num_tiles
-    W = state.dir_sharers.shape[0]
     A = params.directory.associativity
+    W = state.dir_sharers.shape[0] // A
     K = min(params.max_inv_fanout_per_round, T)
     # Election hash-table size: keys are fmix64-mixed, so collisions are
     # birthday-random — with up to T concurrent keys the expected number
@@ -293,7 +306,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # dense [T, T](, A) comparison blocks.
         hitwin = win & hit
         misswin = win & ~hit
-        grank = _grouped_rank(fidx, packed, misswin, T * ndsets)
+        grank = _grouped_rank(fidx, packed, misswin)
         fhash = (dense.fmix64(fidx.astype(jnp.int64))
                  % jnp.uint64(H)).astype(jnp.int32)
         used_tbl = jnp.zeros((H, A), dtype=bool).at[
@@ -337,7 +350,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             dstate != I, way[:, None], axis=1)[:, 0]
 
         downer = dir_meta_owner(dmeta)                        # [T, A]
-        dsharers = state.dir_sharers[:, :, fidx].transpose(2, 1, 0)  # [T,A,W]
+        dsharers = state.dir_sharers[:, fidx].reshape(
+            W, A, T).transpose(2, 1, 0)                       # [T, A, W]
         entry_state = jnp.where(
             hit, jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
         entry_owner = jnp.where(
@@ -462,7 +476,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         key2 = jnp.concatenate([packed, packed])
         # FCFS rank of each delivery within its target tile's budget
         # (sort-based — the old dense [2T, 2T] compare was O(T^2)).
-        posr = _grouped_rank(tgt2, key2, val2, T)         # [2T]
+        posr = _grouped_rank(tgt2, key2, val2)            # [2T]
         over2 = val2 & (posr >= J_OWN)
         ow_defer = over2[:T] | over2[T:]
         win = win1 & ~ow_defer
@@ -488,6 +502,61 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             tgt2_m, slot2].set(True, mode="drop")
         own_tgt = jnp.zeros((T, J_OWN), dtype=jnp.int32).at[
             tgt2_m, slot2].set(down2, mode="drop")
+
+        # ---- shared-read combining.  The per-line FCFS election exists to
+        # serialize CONFLICTING transactions; concurrent SH_REQs against an
+        # I/S entry don't conflict (each independently adds its sharer bit
+        # and reads DRAM — the reference's directory serves them back to
+        # back with no inter-request blocking), so serializing them one
+        # winner per round turned every shared-code i-fetch or read-mostly
+        # line into a T-round convoy (1024 tiles x one line = 1024 rounds).
+        # When EVERY unresolved request for a line is SH and the entry is
+        # I/S, all of them win alongside the elected rep: identical
+        # tag/meta/stamp writes collide harmlessly, sharer bits land as a
+        # guarded disjoint scatter-add after the rep's full-row write, and
+        # the DRAM queue still prices each read.  full_map only (limited
+        # schemes take per-add pointer actions that must serialize); the
+        # MESI slice E-grant on I stays a sole winner.
+        combinable = jnp.zeros_like(win)
+        req_word = (rows // 64).astype(jnp.int32)
+        req_bit1 = jnp.uint64(1) << (rows % 64).astype(jnp.uint64)
+        if params.directory.directory_type == "full_map":
+            sh_entry_ok = (entry_state == I) | (entry_state == S)
+            if params.shared_l2:
+                # A combined read of an UNCACHED slice line would charge
+                # one DRAM fill per reader; the reference's slice fills
+                # once and serves later readers from the slice (and the
+                # MESI E-grant needs a sole first reader anyway) — so
+                # shared-L2 combining applies to S entries only.
+                sh_entry_ok = sh_entry_ok & (entry_state != I)
+            ex_unres = unres & is_ex
+            rep_sh = win & ~is_ex & sh_entry_ok
+            if dense_tables:
+                any_ex = jnp.any(oh_hidx & ex_unres[:, None], axis=0)
+                rline = jnp.max(jnp.where(oh_hidx & rep_sh[:, None],
+                                          line[:, None], -1), axis=0)
+                rway = jnp.max(jnp.where(oh_hidx & rep_sh[:, None],
+                                         way[:, None], -1), axis=0)
+                m_any_ex = _sel(oh_hidx, any_ex.astype(jnp.int32)) > 0
+                m_rline = _sel(oh_hidx, rline)
+                m_rway = _sel(oh_hidx, rway).astype(jnp.int32)
+            else:
+                any_ex_t = jnp.zeros((H,), bool).at[
+                    jnp.where(ex_unres, hidx, H)].set(True, mode="drop")
+                rline_t = jnp.full((H,), -1, jnp.int64).at[
+                    jnp.where(rep_sh, hidx, H)].set(line, mode="drop")
+                rway_t = jnp.full((H,), -1, jnp.int32).at[
+                    jnp.where(rep_sh, hidx, H)].set(way, mode="drop")
+                m_any_ex = any_ex_t[hidx]
+                m_rline = rline_t[hidx]
+                m_rway = rway_t[hidx]
+            combinable = unres & ~win & ~is_ex & sh_entry_ok \
+                & ~m_any_ex & (m_rline == line)
+            win = win | combinable
+            way = jnp.where(combinable, m_rway, way)
+        own_word = jnp.take_along_axis(entry_sharers, req_word[:, None],
+                                       axis=1)[:, 0]
+        sharer_add = combinable & ((own_word & req_bit1) == jnp.uint64(0))
 
         sel = sel0 & ~ow_defer
         rank = queue_models._cumsum_doubling(sel.astype(jnp.int32)) - 1
@@ -701,7 +770,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # like engine/cache.py — the old code maintained rank permutations
         # with dense [T, T, A] merges).
         fidx_w = jnp.where(win, fidx, jnp.int32(2**30))
-        arW = jnp.arange(W)[:, None]
+        # (Combined SH winners of one line write identical tag/meta/stamp
+        # values, so their colliding scatters are safe; the sharer bitmap
+        # is the one per-winner-distinct field — the line's rep rewrites
+        # the row, then the other combined winners add their disjoint
+        # bits on top.)
         state = state._replace(
             dir_tags=state.dir_tags.at[way, fidx_w].set(
                 line.astype(jnp.int32), mode="drop"),
@@ -709,10 +782,29 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 dir_pack(act.new_state, act.new_owner), mode="drop"),
             dir_stamp=state.dir_stamp.at[way, fidx_w].set(
                 state.round_ctr, mode="drop"),
-            dir_sharers=state.dir_sharers.at[
-                arW, way[None, :], fidx_w[None, :]].set(
-                act.new_sharers.T, mode="drop"),
         )
+        # Sharer-bitmap rewrite as per-PLANE modular delta-adds: the slot's
+        # current row is known (the hit entry's words, or the victim's for
+        # a fresh alloc), so adding (new - old) lands the new row exactly —
+        # one [T]-row single-word scatter per plane, where a multi-word
+        # row .set was a W*T-row (or strided-window) scatter that XLA:TPU
+        # serialized at ~30 ms per conflict round at 1024 tiles.
+        # ONE merged scatter-add: every scatter into dir_sharers costs a
+        # full-array sweep on TPU (the lowering loops over major lines),
+        # so the W per-plane rep deltas and the combined winners' bit adds
+        # ride the same op.
+        old_row = jnp.where(hit[:, None], entry_sharers, vsharers)
+        delta = act.new_sharers - old_row          # uint64, modular
+        fidx_rep = jnp.where(win & ~combinable, fidx, jnp.int32(2**30))
+        plane = jnp.arange(W, dtype=jnp.int32)[:, None] * A + way[None, :]
+        add_rows = jnp.concatenate(
+            [plane.reshape(-1), req_word * A + way])
+        add_cols = jnp.concatenate(
+            [jnp.broadcast_to(fidx_rep[None, :], (W, T)).reshape(-1),
+             jnp.where(sharer_add, fidx, jnp.int32(2**30))])
+        add_vals = jnp.concatenate([delta.T.reshape(-1), req_bit1])
+        state = state._replace(dir_sharers=state.dir_sharers.at[
+            add_rows, add_cols].add(add_vals, mode="drop"))
 
         # ---- coherence-driven cache-state changes, one single-pass sweep
         # per cache level over per-target line lists: owner downgrades
@@ -975,8 +1067,9 @@ class _VictimProbe:
     def __init__(self, params: SimParams, state: SimState, tiles, vtag,
                  valid):
         T = params.num_tiles
-        W = state.dir_sharers.shape[0]
         A = params.directory.associativity
+        W = state.dir_sharers.shape[0] // A
+        self.assoc = A
         ndsets = params.directory.num_sets
         self.vhome = home_of_line(params, vtag)
         self.vdset = dir_set_of_line(params, vtag)
@@ -996,7 +1089,7 @@ class _VictimProbe:
         self.esharers = jnp.sum(
             jnp.where((jnp.arange(A, dtype=jnp.int32)[:, None]
                        == self.way[None, :])[None, :, :],
-                      state.dir_sharers[:, :, vfidx],
+                      state.dir_sharers[:, vfidx].reshape(W, A, -1),
                       jnp.uint64(0)), axis=1, dtype=jnp.uint64).T  # [T, W]
         self.word = (tiles // 64).astype(jnp.int32)
         self.bit = jnp.uint64(1) << (tiles % 64).astype(jnp.uint64)
@@ -1021,7 +1114,7 @@ class _VictimProbe:
                       jnp.int32(2**30))
         return state._replace(
             dir_sharers=state.dir_sharers.at[
-                self.word, self.way, f].add(
+                self.word * self.assoc + self.way, f].add(
                 jnp.uint64(0) - self.bit, mode="drop"))
 
 
@@ -1037,7 +1130,8 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
     writeback messages into dram_directory_cntlr.)
     """
     T = params.num_tiles
-    W = state.dir_sharers.shape[0]
+    A = params.directory.associativity
+    W = state.dir_sharers.shape[0] // A
     p = _VictimProbe(params, state, tiles, vtag, valid)
 
     # Owner dropped its M line: entry -> I.
@@ -1056,14 +1150,23 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
 
     state = p.set_meta(state, drop_m | ((drop_s | drop_o) & empty), I, -1)
     state = p.set_meta(state, drop_o & ~empty, S, -1)
-    # M drop wipes the whole bitmap row (the owner was the only holder).
+    # M drop wipes the whole bitmap row (the owner was the only holder) by
+    # modular subtract of the known contents; S/O drops clear one bit.
+    # Merged into ONE scatter-add — each dir_sharers scatter sweeps the
+    # whole array on TPU (see the winner write in resolve_memory).
     fm = jnp.where(drop_m, p.vfidx, jnp.int32(2**30))
-    arW = jnp.arange(W)[:, None]
-    state = state._replace(
-        dir_sharers=state.dir_sharers.at[
-            arW, p.way[None, :], fm[None, :]].set(
-            jnp.zeros((W, T), dtype=jnp.uint64), mode="drop"))
-    return p.clear_bit(state, drop_s | drop_o)
+    clr = drop_s | drop_o
+    fc = jnp.where(clr & p.has_bit, p.vfidx, jnp.int32(2**30))
+    plane = jnp.arange(W, dtype=jnp.int32)[:, None] * A + p.way[None, :]
+    rows2 = jnp.concatenate(
+        [plane.reshape(-1), p.word * A + p.way])
+    cols2 = jnp.concatenate(
+        [jnp.broadcast_to(fm[None, :], (W, T)).reshape(-1), fc])
+    vals2 = jnp.concatenate(
+        [(jnp.uint64(0) - p.esharers.T).reshape(-1),
+         jnp.uint64(0) - p.bit])
+    return state._replace(dir_sharers=state.dir_sharers.at[
+        rows2, cols2].add(vals2, mode="drop"))
 
 
 def _sh_l1_evict_notify(params: SimParams, state: SimState, tiles, vtag,

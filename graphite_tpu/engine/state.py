@@ -205,7 +205,12 @@ class SimState(NamedTuple):
     dir_stamp: jnp.ndarray    # [dassoc, T*dsets] int32 replacement stamp
     #   (monotone access counter; victim = min-stamp way — true LRU, in
     #   scatter-friendly timestamp form like engine/cache.py)
-    dir_sharers: jnp.ndarray  # [W, dassoc, T*dsets] uint64 sharer bitmaps
+    dir_sharers: jnp.ndarray  # [W*dassoc, T*dsets] uint64 sharer bitmaps —
+    #   plane (w, way) lives at row w*dassoc + way.  Two-dimensional so
+    #   every sharer update is a (row, col)-indexed single-word scatter;
+    #   3-D layouts made XLA:TPU serialize the scatters into
+    #   per-(plane, way) dynamic-update-slice loops (~30 ms/round at
+    #   1024 tiles).  See dir_sharers_view for the unpacked view.
 
     # -- iocoom load/store queues (reference: iocoom_core_model.cc:78-;
     # completion-time rings — a load/store miss parks the tile only until
@@ -278,6 +283,14 @@ class SimState(NamedTuple):
         return self.ch_sent.size > 0
 
 
+def dir_sharers_view(state: "SimState", assoc: int) -> jnp.ndarray:
+    """[W*A, F] flat sharer planes -> [A, F, W] word-minor view (for tests
+    and tools; the engine itself works on the flat planes)."""
+    WA, F = state.dir_sharers.shape
+    W = WA // assoc
+    return jnp.moveaxis(state.dir_sharers.reshape(W, assoc, F), 0, -1)
+
+
 def init_periods(params: SimParams) -> np.ndarray:
     p = np.zeros((params.num_tiles, NUM_DVFS_MODULES), dtype=np.int32)
     for m in DVFSModule:
@@ -339,7 +352,8 @@ def make_state(params: SimParams,
             jnp.zeros(d_shape, dtype=jnp.int32),
             jnp.full(d_shape, -1, dtype=jnp.int32)),
         dir_stamp=jnp.zeros(d_shape, dtype=jnp.int32),
-        dir_sharers=jnp.zeros((W,) + d_shape, dtype=jnp.uint64),
+        dir_sharers=jnp.zeros((W * d_shape[0], d_shape[1]),
+                              dtype=jnp.uint64),
         lq_ready=jnp.zeros((params.core.load_queue_entries, T),
                            dtype=jnp.int64),
         sq_ready=jnp.zeros((params.core.store_queue_entries, T),

@@ -52,15 +52,24 @@ def load_binary_trace(path: str, line_size: int = 64) -> Trace:
 
     # ---- address compaction over every page TOUCHED by any access (not
     # just start pages — a straddling access must not spill into an
-    # unrelated host page's compacted id)
+    # unrelated host page's compacted id).  COMPUTE/BRANCH i-fetch
+    # addresses (real code addresses under the TSan frontend) compact
+    # through the same map — code and data pages never collide, so their
+    # L1I behavior survives the remap.
     page_sz = 1 << PAGE_BITS
     touched = set()
     mem_masks = [np.isin(r["op"], _MEM_OPS) for r in per_tile]
+    ifetch_ops = (int(EventOp.COMPUTE), int(EventOp.BRANCH))
     for rec, m in zip(per_tile, mem_masks):
         for a, sz in zip(rec["addr"][m], rec["arg"][m]):
             a, sz = int(a), max(1, int(sz))
             touched.update(range(a >> PAGE_BITS,
                                  ((a + sz - 1) >> PAGE_BITS) + 1))
+        fm = np.isin(rec["op"], ifetch_ops)
+        for a, n in zip(rec["addr"][fm], rec["arg2"][fm]):
+            a, span = int(a), max(1, int(n)) * 4   # ~4 B per instruction
+            touched.update(range(a >> PAGE_BITS,
+                                 ((a + span - 1) >> PAGE_BITS) + 1))
     page_map = {p: i for i, p in enumerate(sorted(touched))}
 
     # ---- page-bounded splitting, per-piece remap, line splitting
@@ -81,6 +90,10 @@ def load_binary_trace(path: str, line_size: int = 64) -> Trace:
                     out.append((op, ca, nxt - a, 0 if first else 1))
                     a = nxt
                     first = False
+            elif op in ifetch_ops and (a >> PAGE_BITS) in page_map:
+                ca = (page_map[a >> PAGE_BITS] << PAGE_BITS) \
+                    | (a & (page_sz - 1))
+                out.append((op, ca, arg, arg2))
             else:
                 out.append((op, a, arg, arg2))
         if not out or out[-1][0] != int(EventOp.DONE):

@@ -45,7 +45,7 @@ _TILE_AXIS_BY_FIELD = {
     "word": 1, "meta": 1,            # CacheArrays [A, T, sets] / trace
     "dir_tags": 1, "dir_meta": 1,    # [A, T*dsets] (tile-major flat)
     "dir_stamp": 1,
-    "dir_sharers": 2,                # [W, A, T*dsets]
+    "dir_sharers": 1,                # [W*A, T*dsets]
     "ch_time": 1,                    # [D, T, T]
     "lq_ready": 1, "sq_ready": 1,    # [entries, T]
     "link_free_mem": 1,              # [NUM_DIRS, T]

@@ -1,0 +1,37 @@
+/* Unmodified pthreads program: parallel sum with a mutex + barrier. */
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define NT 4
+#define N 1000
+
+static long total;
+static long data[NT][N];
+static pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+static pthread_barrier_t bar;
+
+static void *worker(void *p) {
+    long id = (long)p;
+    long local = 0;
+    for (int i = 0; i < N; i++) {
+        data[id][i] = id * N + i;
+        local += data[id][i];
+    }
+    pthread_barrier_wait(&bar);
+    pthread_mutex_lock(&mu);
+    total += local;
+    pthread_mutex_unlock(&mu);
+    return NULL;
+}
+
+int main(void) {
+    pthread_t th[NT];
+    pthread_barrier_init(&bar, NULL, NT);
+    for (long i = 0; i < NT; i++)
+        pthread_create(&th[i], NULL, worker, (void *)i);
+    for (int i = 0; i < NT; i++)
+        pthread_join(th[i], NULL);
+    printf("total=%ld\n", total);
+    return total == (long)NT * N * (NT * N - 1) / 2 ? 0 : 1;
+}

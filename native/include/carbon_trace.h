@@ -108,6 +108,20 @@ void CarbonBranch(int taken);
 #define CARBON_STORE(type, ptr, val) \
     (CarbonMemWrite((ptr), sizeof(type)), (void)(*(type *)(ptr) = (val)))
 
+/* ---- capture-internal hooks (the TSan-instrumentation + pthread
+ * interposition layer in tsan_capture.cc builds on these; they are the
+ * no-Pin analog of the reference's routine-replacement plumbing,
+ * pin/lite/routine_replace.cc:26-) ---- */
+/* Append a raw event to the calling thread's tile stream (no-op when the
+ * thread is not bound to a tile). */
+void CarbonEmitEvent(int op, long long addr, int arg, int arg2);
+/* Reserve the next tile id for a thread about to start (-1 when full). */
+int CarbonAllocTile(void);
+/* Bind the calling thread to a reserved tile. */
+void CarbonAdoptThread(int tile);
+/* Is capture running (CarbonStartSim called, CarbonStopSim not yet)? */
+int CarbonCaptureActive(void);
+
 #ifdef __cplusplus
 }
 #endif

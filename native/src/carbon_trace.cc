@@ -285,4 +285,20 @@ void CarbonBranch(int taken) {
     emit(CARBON_EV_BRANCH, 0x400000, taken, 0);
 }
 
+/* ---- capture-internal hooks (see carbon_trace.h) ---- */
+
+void CarbonEmitEvent(int op, long long addr, int arg, int arg2) {
+    emit(op, (int64_t)addr, arg, arg2);
+}
+
+int CarbonAllocTile(void) {
+    if (!g_rt) return -1;
+    int tile = g_rt->next_tile.fetch_add(1);
+    return tile < g_rt->max_tiles ? tile : -1;
+}
+
+void CarbonAdoptThread(int tile) { tl_tile = tile; }
+
+int CarbonCaptureActive(void) { return g_rt != nullptr; }
+
 }  /* extern "C" */

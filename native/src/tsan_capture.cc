@@ -1,0 +1,432 @@
+/* tsan_capture.cc — automatic capture of UNMODIFIED pthreads programs.
+ *
+ * The reference runs unmodified binaries under Pin: every instruction and
+ * memory operand gets an injected analysis call
+ * (pin/lite/memory_modeling.cc:13-57) and pthread entry points are
+ * swapped for simulator versions (pin/lite/routine_replace.cc:26-).
+ * Pin does not exist for this toolchain, so graphite_tpu reaches the
+ * same zero-annotation goal with two compiler-level mechanisms:
+ *
+ *   1. **ThreadSanitizer instrumentation as a probe generator** — the
+ *      app is compiled with ``-fsanitize=thread``, which plants a
+ *      ``__tsan_read{1..16}/write{1..16}`` call before every memory
+ *      access and ``__tsan_func_entry/exit`` around calls.  Linking
+ *      against THIS runtime (instead of libtsan) turns each probe into
+ *      a trace event: reads/writes record MEM events with real host
+ *      addresses, atomics perform the real atomic op AND record an
+ *      ATOMIC event, and function entries accumulate an approximate
+ *      COMPUTE cost (TSan probes carry no instruction counts — the
+ *      per-access/per-call instruction estimates are configurable via
+ *      CARBON_TSAN_INSTR_PER_ACCESS / _PER_CALL, default 2 / 6, playing
+ *      the role of Pin's basic-block instruction tallies).
+ *   2. **pthread interposition via ``-Wl,--wrap``** — pthread_create /
+ *      join / mutex / cond / barrier calls are routed through wrappers
+ *      that record SPAWN/JOIN/sync events and then run the REAL pthread
+ *      call (native execution must stay correct), mirroring the
+ *      reference's replaced-function table (routine_replace.cc:43-101).
+ *
+ * Capture auto-starts at program load (constructor) and writes the trace
+ * at exit: CARBON_TRACE_PATH (default "carbon_trace.bin"),
+ * CARBON_MAX_TILES (default 64).  tools/capture_build.sh assembles the
+ * full compile+link line.
+ */
+
+#include "carbon_trace.h"
+
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <map>
+#include <mutex>
+
+extern "C" {
+int __real_pthread_create(pthread_t *, const pthread_attr_t *,
+                          void *(*)(void *), void *);
+int __real_pthread_join(pthread_t, void **);
+int __real_pthread_mutex_init(pthread_mutex_t *,
+                              const pthread_mutexattr_t *);
+int __real_pthread_mutex_lock(pthread_mutex_t *);
+int __real_pthread_mutex_unlock(pthread_mutex_t *);
+int __real_pthread_cond_init(pthread_cond_t *, const pthread_condattr_t *);
+int __real_pthread_cond_wait(pthread_cond_t *, pthread_mutex_t *);
+int __real_pthread_cond_signal(pthread_cond_t *);
+int __real_pthread_cond_broadcast(pthread_cond_t *);
+int __real_pthread_barrier_init(pthread_barrier_t *,
+                                const pthread_barrierattr_t *, unsigned);
+int __real_pthread_barrier_wait(pthread_barrier_t *);
+}
+
+namespace {
+
+int g_instr_per_access = 2;
+int g_instr_per_call = 6;
+
+thread_local long tl_icount = 0;
+thread_local uint64_t tl_pc = 0x400000;
+
+/* Reentrancy guard: the recording path takes internal locks
+ * (std::mutex -> pthread_mutex_lock), which are themselves wrapped — an
+ * unguarded wrapper would recurse to stack overflow AND record phantom
+ * events for runtime-internal locks.  While the flag is set, wrapped
+ * pthread calls pass straight through to __real_*.  (Runtime-internal
+ * code paths that take locks — e.g. CAPI channels in carbon_trace.cc —
+ * are not expected under TSan capture: plain pthreads apps don't call
+ * the Carbon API.) */
+thread_local bool tl_inside = false;
+struct Reent {
+    Reent() { tl_inside = true; }
+    ~Reent() { tl_inside = false; }
+};
+
+/* pthread-object -> carbon sync id (created lazily so statically
+ * initialized objects work); pthread_t -> tile for JOIN events. */
+std::mutex g_mu;
+std::map<void *, int> g_ids[3];   /* 0 = mutex, 1 = cond, 2 = barrier */
+int g_next_id[3] = {0, 0, 0};
+std::map<void *, int> g_bar_count;
+std::map<pthread_t, int> g_thread_tile;
+
+int obj_id(int kind, void *obj) {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_ids[kind].find(obj);
+    if (it != g_ids[kind].end()) return it->second;
+    int id = g_next_id[kind]++;
+    g_ids[kind][obj] = id;
+    return id;
+}
+
+void flush_compute() {
+    if (tl_icount > 0 && CarbonCaptureActive()) {
+        /* cycles ~= instructions (in-order, IPC ~1 between accesses);
+         * the engine adds per-access memory time on top. */
+        CarbonEmitEvent(CARBON_EV_COMPUTE, (long long)tl_pc,
+                        (int)tl_icount, (int)tl_icount);
+        tl_icount = 0;
+    }
+}
+
+void access(int op, void *addr, int size) {
+    tl_icount += g_instr_per_access;
+    flush_compute();
+    CarbonEmitEvent(op, (long long)(uintptr_t)addr, size, 0);
+}
+
+struct Tram {
+    void *(*fn)(void *);
+    void *arg;
+    int tile;
+};
+
+void *trampoline(void *p) {
+    Tram *t = (Tram *)p;
+    CarbonAdoptThread(t->tile);
+    CarbonEmitEvent(CARBON_EV_THREAD_START, 0, 0, 0);
+    void *ret = t->fn(t->arg);
+    flush_compute();
+    CarbonEmitEvent(CARBON_EV_DONE, 0, 0, 0);
+    delete t;
+    return ret;
+}
+
+int env_int(const char *name, int dflt) {
+    const char *v = getenv(name);
+    return v ? atoi(v) : dflt;
+}
+
+__attribute__((constructor)) void capture_ctor() {
+    g_instr_per_access = env_int("CARBON_TSAN_INSTR_PER_ACCESS", 2);
+    g_instr_per_call = env_int("CARBON_TSAN_INSTR_PER_CALL", 6);
+    CarbonStartSim(env_int("CARBON_MAX_TILES", 64));
+}
+
+__attribute__((destructor)) void capture_dtor() {
+    if (!CarbonCaptureActive()) return;
+    flush_compute();
+    const char *path = getenv("CARBON_TRACE_PATH");
+    CarbonStopSim(path ? path : "carbon_trace.bin");
+}
+
+}  // namespace
+
+extern "C" {
+
+/* ---- pthread interposition (-Wl,--wrap,...) ---- */
+
+int __wrap_pthread_create(pthread_t *th, const pthread_attr_t *attr,
+                          void *(*fn)(void *), void *arg) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_create(th, attr, fn, arg);
+    Reent r;
+    int tile = CarbonAllocTile();
+    if (tile < 0) return __real_pthread_create(th, attr, fn, arg);
+    flush_compute();
+    CarbonEmitEvent(CARBON_EV_SPAWN, 0, 0, tile);
+    Tram *t = new Tram{fn, arg, tile};
+    int rc = __real_pthread_create(th, attr, trampoline, t);
+    if (rc != 0) {
+        delete t;
+        return rc;
+    }
+    std::lock_guard<std::mutex> g(g_mu);
+    g_thread_tile[*th] = tile;
+    return 0;
+}
+
+int __wrap_pthread_join(pthread_t th, void **ret) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_join(th, ret);
+    Reent r;
+    int tile = -1;
+    {
+        std::lock_guard<std::mutex> g(g_mu);
+        auto it = g_thread_tile.find(th);
+        if (it != g_thread_tile.end()) tile = it->second;
+    }
+    if (tile >= 0) {
+        flush_compute();
+        CarbonEmitEvent(CARBON_EV_JOIN, 0, 0, tile);
+    }
+    return __real_pthread_join(th, ret);
+}
+
+int __wrap_pthread_mutex_init(pthread_mutex_t *m,
+                              const pthread_mutexattr_t *a) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_mutex_init(m, a);
+    Reent r;
+    obj_id(0, m);
+    return __real_pthread_mutex_init(m, a);
+}
+
+int __wrap_pthread_mutex_lock(pthread_mutex_t *m) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_mutex_lock(m);
+    Reent r;
+    flush_compute();
+    CarbonEmitEvent(CARBON_EV_MUTEX_LOCK, 0, obj_id(0, m), 0);
+    return __real_pthread_mutex_lock(m);
+}
+
+int __wrap_pthread_mutex_unlock(pthread_mutex_t *m) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_mutex_unlock(m);
+    Reent r;
+    int rc = __real_pthread_mutex_unlock(m);
+    flush_compute();
+    CarbonEmitEvent(CARBON_EV_MUTEX_UNLOCK, 0, obj_id(0, m), 0);
+    return rc;
+}
+
+int __wrap_pthread_cond_init(pthread_cond_t *c,
+                             const pthread_condattr_t *a) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_cond_init(c, a);
+    Reent r;
+    obj_id(1, c);
+    return __real_pthread_cond_init(c, a);
+}
+
+int __wrap_pthread_cond_wait(pthread_cond_t *c, pthread_mutex_t *m) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_cond_wait(c, m);
+    {
+        Reent r;
+        flush_compute();
+        CarbonEmitEvent(CARBON_EV_COND_WAIT, 0, obj_id(1, c),
+                        obj_id(0, m));
+    }
+    /* The real wait re-acquires the mutex internally; the guard is off
+     * so that path goes straight through __real_ anyway (glibc calls
+     * futexes, not our wrappers). */
+    return __real_pthread_cond_wait(c, m);
+}
+
+int __wrap_pthread_cond_signal(pthread_cond_t *c) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_cond_signal(c);
+    Reent r;
+    flush_compute();
+    CarbonEmitEvent(CARBON_EV_COND_SIGNAL, 0, obj_id(1, c), 0);
+    return __real_pthread_cond_signal(c);
+}
+
+int __wrap_pthread_cond_broadcast(pthread_cond_t *c) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_cond_broadcast(c);
+    Reent r;
+    flush_compute();
+    CarbonEmitEvent(CARBON_EV_COND_BROADCAST, 0, obj_id(1, c), 0);
+    return __real_pthread_cond_broadcast(c);
+}
+
+int __wrap_pthread_barrier_init(pthread_barrier_t *b,
+                                const pthread_barrierattr_t *a,
+                                unsigned count) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_barrier_init(b, a, count);
+    Reent r;
+    obj_id(2, b);
+    {
+        std::lock_guard<std::mutex> g(g_mu);
+        g_bar_count[b] = (int)count;
+    }
+    return __real_pthread_barrier_init(b, a, count);
+}
+
+int __wrap_pthread_barrier_wait(pthread_barrier_t *b) {
+    if (tl_inside || !CarbonCaptureActive())
+        return __real_pthread_barrier_wait(b);
+    {
+        Reent r;
+        int count = 0;
+        {
+            std::lock_guard<std::mutex> g(g_mu);
+            auto it = g_bar_count.find(b);
+            count = it != g_bar_count.end() ? it->second : 1;
+        }
+        flush_compute();
+        CarbonEmitEvent(CARBON_EV_BARRIER_WAIT, 0, obj_id(2, b), count);
+    }
+    return __real_pthread_barrier_wait(b);
+}
+
+/* ---- TSan instrumentation hooks (the gcc -fsanitize=thread ABI) ---- */
+
+void __tsan_init(void) {}
+void __tsan_func_entry(void *call_pc) {
+    tl_pc = (uint64_t)(uintptr_t)call_pc;
+    tl_icount += g_instr_per_call;
+}
+void __tsan_func_exit(void) {}
+
+#define TSAN_ACCESS(n)                                              \
+    void __tsan_read##n(void *a) { access(CARBON_EV_MEM_READ, a, n); } \
+    void __tsan_write##n(void *a) { access(CARBON_EV_MEM_WRITE, a, n); }
+TSAN_ACCESS(1)
+TSAN_ACCESS(2)
+TSAN_ACCESS(4)
+TSAN_ACCESS(8)
+TSAN_ACCESS(16)
+#undef TSAN_ACCESS
+
+#define TSAN_UNALIGNED(n)                                            \
+    void __tsan_unaligned_read##n(void *a) {                          \
+        access(CARBON_EV_MEM_READ, a, n);                             \
+    }                                                                 \
+    void __tsan_unaligned_write##n(void *a) {                         \
+        access(CARBON_EV_MEM_WRITE, a, n);                            \
+    }
+TSAN_UNALIGNED(2)
+TSAN_UNALIGNED(4)
+TSAN_UNALIGNED(8)
+TSAN_UNALIGNED(16)
+#undef TSAN_UNALIGNED
+
+void __tsan_read_range(void *a, unsigned long size) {
+    access(CARBON_EV_MEM_READ, a, (int)(size > 255 ? 255 : size));
+}
+void __tsan_write_range(void *a, unsigned long size) {
+    access(CARBON_EV_MEM_WRITE, a, (int)(size > 255 ? 255 : size));
+}
+void __tsan_vptr_update(void **vptr, void *val) {
+    (void)val;
+    access(CARBON_EV_MEM_WRITE, (void *)vptr, 8);
+}
+void __tsan_vptr_read(void **vptr) {
+    access(CARBON_EV_MEM_READ, (void *)vptr, 8);
+}
+
+/* Atomics: PERFORM the operation (app correctness) and record one
+ * ATOMIC event.  Orders are clamped to seq_cst — conservative and
+ * correct for capture. */
+#define TSAN_ATOMIC(bits, type)                                          \
+    type __tsan_atomic##bits##_load(const volatile type *a, int mo) {    \
+        (void)mo;                                                        \
+        access(CARBON_EV_MEM_READ, (void *)a, bits / 8);                 \
+        return __atomic_load_n(a, __ATOMIC_SEQ_CST);                     \
+    }                                                                    \
+    void __tsan_atomic##bits##_store(volatile type *a, type v, int mo) { \
+        (void)mo;                                                        \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        __atomic_store_n(a, v, __ATOMIC_SEQ_CST);                        \
+    }                                                                    \
+    type __tsan_atomic##bits##_exchange(volatile type *a, type v,        \
+                                        int mo) {                        \
+        (void)mo;                                                        \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        return __atomic_exchange_n(a, v, __ATOMIC_SEQ_CST);              \
+    }                                                                    \
+    type __tsan_atomic##bits##_fetch_add(volatile type *a, type v,       \
+                                         int mo) {                       \
+        (void)mo;                                                        \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        return __atomic_fetch_add(a, v, __ATOMIC_SEQ_CST);               \
+    }                                                                    \
+    type __tsan_atomic##bits##_fetch_sub(volatile type *a, type v,       \
+                                         int mo) {                       \
+        (void)mo;                                                        \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        return __atomic_fetch_sub(a, v, __ATOMIC_SEQ_CST);               \
+    }                                                                    \
+    type __tsan_atomic##bits##_fetch_and(volatile type *a, type v,       \
+                                         int mo) {                       \
+        (void)mo;                                                        \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        return __atomic_fetch_and(a, v, __ATOMIC_SEQ_CST);               \
+    }                                                                    \
+    type __tsan_atomic##bits##_fetch_or(volatile type *a, type v,        \
+                                        int mo) {                        \
+        (void)mo;                                                        \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        return __atomic_fetch_or(a, v, __ATOMIC_SEQ_CST);                \
+    }                                                                    \
+    type __tsan_atomic##bits##_fetch_xor(volatile type *a, type v,       \
+                                         int mo) {                       \
+        (void)mo;                                                        \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        return __atomic_fetch_xor(a, v, __ATOMIC_SEQ_CST);               \
+    }                                                                    \
+    int __tsan_atomic##bits##_compare_exchange_strong(                   \
+        volatile type *a, type *expected, type desired, int mo,          \
+        int fail_mo) {                                                   \
+        (void)mo;                                                        \
+        (void)fail_mo;                                                   \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        return __atomic_compare_exchange_n(a, expected, desired, 0,      \
+                                           __ATOMIC_SEQ_CST,             \
+                                           __ATOMIC_SEQ_CST);            \
+    }                                                                    \
+    int __tsan_atomic##bits##_compare_exchange_weak(                     \
+        volatile type *a, type *expected, type desired, int mo,          \
+        int fail_mo) {                                                   \
+        (void)mo;                                                        \
+        (void)fail_mo;                                                   \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        return __atomic_compare_exchange_n(a, expected, desired, 1,      \
+                                           __ATOMIC_SEQ_CST,             \
+                                           __ATOMIC_SEQ_CST);            \
+    }                                                                    \
+    type __tsan_atomic##bits##_compare_exchange_val(                     \
+        volatile type *a, type expected, type desired, int mo,           \
+        int fail_mo) {                                                   \
+        (void)mo;                                                        \
+        (void)fail_mo;                                                   \
+        access(CARBON_EV_ATOMIC, (void *)a, bits / 8);                   \
+        type exp = expected;                                             \
+        __atomic_compare_exchange_n(a, &exp, desired, 0,                 \
+                                    __ATOMIC_SEQ_CST, __ATOMIC_SEQ_CST); \
+        return exp;                                                      \
+    }
+
+TSAN_ATOMIC(8, uint8_t)
+TSAN_ATOMIC(16, uint16_t)
+TSAN_ATOMIC(32, uint32_t)
+TSAN_ATOMIC(64, uint64_t)
+#undef TSAN_ATOMIC
+
+void __tsan_atomic_thread_fence(int mo) { (void)mo; }
+void __tsan_atomic_signal_fence(int mo) { (void)mo; }
+
+}  /* extern "C" */

@@ -66,3 +66,12 @@ def test_shared_l2_equivalent():
     trace = synth.gen_radix(num_tiles=8, keys_per_tile=48, radix=16, seed=11)
     over = {"caching_protocol/type": "pr_l1_sh_l2_mesi"}
     _assert_equal(_run(trace, 8, 0, **over), _run(trace, 8, 16, **over))
+
+
+def test_round_robin_equivalent():
+    """Replacement-policy paths must advance identically in both engines
+    (the rr pointer moves on every non-resident install)."""
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=24, radix=8, seed=3)
+    over = {"l1_dcache/replacement_policy": "round_robin",
+            "l1_icache/replacement_policy": "round_robin"}
+    _assert_equal(_run(trace, 4, 0, **over), _run(trace, 4, 16, **over))

@@ -48,7 +48,9 @@ def counters_np(s):
 
 
 def _sharer_popcounts(sim):
-    sh = np.moveaxis(np.asarray(sim.state.dir_sharers), 0, -1)  # [A,T,ds,W]
+    from graphite_tpu.engine.state import dir_sharers_view
+    sh = np.asarray(dir_sharers_view(
+        sim.state, sim.params.directory.associativity))  # [A, F, W]
     return np.array([bin(int(w)).count("1")
                      for w in sh.reshape(-1, sh.shape[-1])[:, 0]])
 

@@ -60,7 +60,9 @@ def test_shared_readers_sharer_bitmap():
     # the directory must now record all 8 tiles as sharers of each line
     from graphite_tpu.engine.state import dir_meta_state
     dstate = np.asarray(dir_meta_state(sim.state.dir_meta))  # [A, T, dsets]
-    dsharers = np.moveaxis(np.asarray(sim.state.dir_sharers), 0, -1)
+    from graphite_tpu.engine.state import dir_sharers_view
+    dsharers = np.asarray(dir_sharers_view(
+        sim.state, sim.params.directory.associativity))
     shared_entries = dstate == cachemod.S
     assert shared_entries.sum() == 8  # 8 lines tracked, one entry each
     bits = dsharers[shared_entries]
@@ -110,7 +112,9 @@ def test_write_invalidates_sharers():
     assert int(c["dir_writebacks"].sum()) == 1
     from graphite_tpu.engine.state import dir_meta_state
     dstate = np.asarray(dir_meta_state(sim.state.dir_meta))  # [A, T, dsets]
-    dsharers = np.moveaxis(np.asarray(sim.state.dir_sharers), 0, -1)
+    from graphite_tpu.engine.state import dir_sharers_view
+    dsharers = np.asarray(dir_sharers_view(
+        sim.state, sim.params.directory.associativity))
     s_entries = dstate == cachemod.S
     assert s_entries.sum() == 1
     assert dsharers[s_entries][0, 0] == np.uint64(0b101)

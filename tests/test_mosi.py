@@ -80,7 +80,9 @@ def test_o_entry_state_and_owner_kept():
     o_entries = dstate == cachemod.O
     assert o_entries.sum() == 1
     assert downer[o_entries][0] == 0          # tile 0 still owns the line
-    dsharers = np.moveaxis(np.asarray(sim.state.dir_sharers), 0, -1)
+    from graphite_tpu.engine.state import dir_sharers_view
+    dsharers = np.asarray(dir_sharers_view(
+        sim.state, sim.params.directory.associativity))
     # owner + both readers all in the sharer bitmap
     assert dsharers[o_entries][0, 0] == np.uint64(0b111)
     # the owner's own L2 copy is in O (downgraded from M, not S/I)

@@ -86,7 +86,9 @@ def test_mesi_second_reader_downgrades_owner():
     c = counters_np(s)
     assert int(c["dir_writebacks"].sum()) == 1
     dstate = np.asarray(dir_meta_state(sim.state.dir_meta))
-    dsharers = np.moveaxis(np.asarray(sim.state.dir_sharers), 0, -1)
+    from graphite_tpu.engine.state import dir_sharers_view
+    dsharers = np.asarray(dir_sharers_view(
+        sim.state, sim.params.directory.associativity))
     o_entries = dstate == cachemod.O
     assert o_entries.sum() == 1
     assert dsharers[o_entries][0, 0] == np.uint64(0b11)

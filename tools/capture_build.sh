@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Build an UNMODIFIED pthreads C program for trace capture:
+#   tools/capture_build.sh app.c [more.c ...] -o app [extra cc flags]
+#
+# Compiles with -fsanitize=thread (plants __tsan_* probes before every
+# memory access) and links against native/build/libcarbon_tsan.a instead
+# of libtsan, with pthread entry points rerouted via -Wl,--wrap — the
+# no-Pin equivalent of the reference's dynamic instrumentation
+# (pin/lite/memory_modeling.cc + routine_replace.cc).  Run the result
+# with CARBON_TRACE_PATH=/path/trace.bin CARBON_MAX_TILES=N.
+set -euo pipefail
+here="$(cd "$(dirname "$0")/.." && pwd)"
+make -s -C "$here/native" build/libcarbon_tsan.a
+
+WRAPS=(pthread_create pthread_join pthread_mutex_init pthread_mutex_lock
+       pthread_mutex_unlock pthread_cond_init pthread_cond_wait
+       pthread_cond_signal pthread_cond_broadcast pthread_barrier_init
+       pthread_barrier_wait)
+wrapflags=()
+for w in "${WRAPS[@]}"; do wrapflags+=("-Wl,--wrap,$w"); done
+
+srcs=()
+out="a.out"
+extra=()
+while [ $# -gt 0 ]; do
+    case "$1" in
+        -o) out="$2"; shift 2 ;;
+        *.c|*.C) srcs+=("$1"); shift ;;
+        *) extra+=("$1"); shift ;;
+    esac
+done
+
+objs=()
+tmpd="$(mktemp -d)"
+trap 'rm -rf "$tmpd"' EXIT
+for s in "${srcs[@]}"; do
+    o="$tmpd/$(basename "${s%.*}").o"
+    gcc -O1 -g -fsanitize=thread -fno-omit-frame-pointer \
+        "${extra[@]}" -c "$s" -o "$o"
+    objs+=("$o")
+done
+
+# Link WITHOUT -fsanitize=thread so libtsan is not pulled in; our runtime
+# provides every __tsan_* symbol the instrumentation references.
+gcc "${objs[@]}" "${wrapflags[@]}" \
+    "$here/native/build/libcarbon_tsan.a" \
+    -lpthread -lstdc++ -lm -o "$out"
+echo "built $out (capture-instrumented)"

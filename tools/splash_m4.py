@@ -1,0 +1,144 @@
+"""Mini-m4 expander for the SPLASH-2 parallel-macro dialect.
+
+The SPLASH-2 sources the reference vendors (tests/benchmarks/*/*.C) are
+written against m4 macro sets (tests/benchmarks/splash_support/c.m4.*)
+and preprocessed by system m4 in the reference's build
+(tests/Makefile.tests); this image ships no m4, so the capture toolchain
+brings its own expander covering the subset those macro files use:
+
+  * ``divert(-1)`` / ``divert(0)`` suppression regions,
+  * ``define(NAME, `BODY')`` with m4 backquote quoting and $1..$9
+    positional parameters,
+  * ``dnl`` comment-to-end-of-line,
+  * recursive macro invocation NAME or NAME(arg, ...) with nested-paren
+    argument scanning.
+
+Usage: python tools/splash_m4.py MACROS.m4 SOURCE.C > SOURCE.c
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+_NAME = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _strip_quotes(s: str) -> str:
+    s = s.strip()
+    if s.startswith("`") and s.endswith("'"):
+        return s[1:-1]
+    return s
+
+
+def _scan_args(text: str, start: int):
+    """Parse '(arg, arg, ...)' at text[start] (start points at '(').
+    Returns (args, index_after_close).  Commas split only at top paren
+    level outside m4 quotes."""
+    assert text[start] == "("
+    depth = 0
+    quote = 0
+    args = []
+    cur = []
+    i = start
+    while i < len(text):
+        ch = text[i]
+        if ch == "`":
+            quote += 1
+        elif ch == "'" and quote:
+            quote -= 1
+        elif not quote and ch == "(":
+            depth += 1
+            if depth == 1:
+                i += 1
+                continue
+        elif not quote and ch == ")":
+            depth -= 1
+            if depth == 0:
+                args.append("".join(cur))
+                return [a.strip() for a in args], i + 1
+        elif not quote and ch == "," and depth == 1:
+            args.append("".join(cur))
+            cur = []
+            i += 1
+            continue
+        if depth >= 1:
+            cur.append(ch)
+        i += 1
+    raise ValueError("unbalanced parens in macro call")
+
+
+def parse_defs(macro_text: str) -> dict:
+    """Collect define(NAME, BODY) from a macro file (divert regions and
+    dnl handled)."""
+    text = re.sub(r"dnl[^\n]*", "", macro_text)
+    defs = {}
+    i = 0
+    while True:
+        m = re.compile(r"define\(").search(text, i)
+        if not m:
+            break
+        # name up to first comma at depth 1
+        args, end = _scan_args(text, m.end() - 1)
+        if len(args) >= 1:
+            name = _strip_quotes(args[0])
+            body = _strip_quotes(",".join(args[1:])) if len(args) > 1 else ""
+            defs[name] = body
+        i = end
+    return defs
+
+
+def expand(text: str, defs: dict, depth: int = 0) -> str:
+    if depth > 50:
+        raise RecursionError("macro expansion too deep")
+    out = []
+    i = 0
+    n = len(text)
+    while i < n:
+        ch = text[i]
+        if ch.isalpha() or ch == "_":
+            m = _NAME.match(text, i)
+            name = m.group(0)
+            if name in defs and not _is_mid_identifier(text, i):
+                j = m.end()
+                args = []
+                if j < n and text[j] == "(":
+                    args, j = _scan_args(text, j)
+                body = defs[name]
+                for k in range(9, 0, -1):
+                    val = _strip_quotes(args[k - 1]) if k <= len(args) else ""
+                    body = body.replace(f"${k}", val)
+                out.append(expand(body, defs, depth + 1))
+                i = j
+                continue
+            out.append(name)
+            i = m.end()
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def _is_mid_identifier(text: str, i: int) -> bool:
+    return i > 0 and (text[i - 1].isalnum() or text[i - 1] in "_.")
+
+
+def expand_file(macro_path: str, src_path: str) -> str:
+    defs = parse_defs(open(macro_path).read())
+    src = open(src_path).read()
+    # SPLASH sources never define macros themselves; strip stray m4
+    # quoting that survives expansion.
+    expanded = expand(src, defs)
+    return expanded.replace("`", "\"").replace("\xb4", "'")
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print(__doc__, file=sys.stderr)
+        return 2
+    sys.stdout.write(expand_file(sys.argv[1], sys.argv[2]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
