@@ -120,7 +120,7 @@ def _captured_radix_row():
             trace_path = os.path.join(td, "radix.trc")
             env = dict(os.environ, CARBON_TRACE_PATH=trace_path,
                        CARBON_MAX_TILES="64")
-            subprocess.run([exe, "-p64", "-n131072", "-r256"], check=True,
+            subprocess.run([exe, "-p64", "-n32768", "-r256"], check=True,
                            env=env, capture_output=True)
             from graphite_tpu.events.binio import load_binary_trace
             trace = load_binary_trace(trace_path)

@@ -112,15 +112,22 @@ _NODE_DYN = {45: 1.0, 32: 0.60, 22: 0.38}
 _NODE_LEAK = {45: 1.0, 32: 0.85, 22: 0.75}
 
 
-def _cache_access_pj(size_kb: int, assoc: int) -> float:
+def _cache_access_pj(size_kb: int, assoc: int, banks: int = 1) -> float:
     """CACTI-shaped SRAM access energy: grows with sqrt(capacity) and
-    mildly with associativity (more ways read per access)."""
-    return 0.4 * math.sqrt(max(size_kb, 1)) * (1.0 + 0.08 * assoc)
+    mildly with associativity (more ways read per access).  Banking cuts
+    dynamic access energy — each access activates one bank of
+    size/banks — at an area premium (CACTI's banked organization; the
+    [cache]/num_banks knob the reference feeds McPAT)."""
+    banks = max(banks, 1)
+    return 0.4 * math.sqrt(max(size_kb / banks, 1)) \
+        * (1.0 + 0.08 * assoc)
 
 
-def _cache_area_mm2(size_kb: int, tech_nm: int) -> float:
-    """~0.25 mm^2 per 256KB at 45nm, scaling with node^2."""
-    return 0.25 * (size_kb / 256.0) * (tech_nm / 45.0) ** 2
+def _cache_area_mm2(size_kb: int, tech_nm: int, banks: int = 1) -> float:
+    """~0.25 mm^2 per 256KB at 45nm, scaling with node^2; each extra bank
+    adds ~3% periphery overhead (decoders/sense amps per bank)."""
+    return 0.25 * (size_kb / 256.0) * (tech_nm / 45.0) ** 2 \
+        * (1.0 + 0.03 * (max(banks, 1) - 1))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,9 +190,12 @@ def compute_energy(params, counters: Dict[str, np.ndarray],
 
     core = pj * vm(DVFSModule.CORE) * (
         _E_INST_PJ * c["icount"] + _E_BRANCH_PJ * c["branches"])
-    e_l1i = _cache_access_pj(params.l1i.size_kb, params.l1i.associativity)
-    e_l1d = _cache_access_pj(params.l1d.size_kb, params.l1d.associativity)
-    e_l2 = _cache_access_pj(params.l2.size_kb, params.l2.associativity)
+    e_l1i = _cache_access_pj(params.l1i.size_kb, params.l1i.associativity,
+                             params.l1i.num_banks)
+    e_l1d = _cache_access_pj(params.l1d.size_kb, params.l1d.associativity,
+                             params.l1d.num_banks)
+    e_l2 = _cache_access_pj(params.l2.size_kb, params.l2.associativity,
+                            params.l2.num_banks)
     l1i = pj * vm(DVFSModule.L1_ICACHE) * e_l1i * c["l1i_access"]
     l1d = pj * vm(DVFSModule.L1_DCACHE) * e_l1d * (
         c["l1d_read"] + c["l1d_write"])
@@ -217,9 +227,12 @@ def compute_energy(params, counters: Dict[str, np.ndarray],
         * np.ones_like(core)
 
     area = (2.0 * (tech / 45.0) ** 2            # core + router
-            + _cache_area_mm2(params.l1i.size_kb, tech)
-            + _cache_area_mm2(params.l1d.size_kb, tech)
-            + _cache_area_mm2(params.l2.size_kb, tech))
+            + _cache_area_mm2(params.l1i.size_kb, tech,
+                              params.l1i.num_banks)
+            + _cache_area_mm2(params.l1d.size_kb, tech,
+                              params.l1d.num_banks)
+            + _cache_area_mm2(params.l2.size_kb, tech,
+                              params.l2.num_banks))
     return EnergyBreakdown(core=core, l1i=l1i, l1d=l1d, l2=l2,
                            directory=directory, dram=dram, network=network,
                            leakage=leakage, area_mm2_per_tile=area)

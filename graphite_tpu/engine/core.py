@@ -458,6 +458,7 @@ def _complex_slot(params: SimParams, state: SimState,
                     | (op == EventOp.SYNC)
                     | (op == EventOp.SPAWN)
                     | (op == EventOp.DVFS_SET)
+                    | (op == EventOp.SYSCALL)
                     | (op == EventOp.DONE))
         if not params.core.speculative_loads:
             drain_op = drain_op | (op == EventOp.BRANCH)
@@ -643,6 +644,20 @@ def _complex_slot(params: SimParams, state: SimState,
     is_done = op == EventOp.DONE
     dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
     dt_dvfs = _lat(params.dvfs_sync_delay_cycles, p_core)
+
+    # SYSCALL: marshalled args ride the user network to the MCP's syscall
+    # server, service takes the per-class cost, the result rides back
+    # (SyscallMdl round trip, syscall_model.cc; server dispatch
+    # syscall_server.cc:43-130).  Closed-form — no cross-tile dependency,
+    # so no park.  Futexes never reach here (they surface as sync events).
+    is_sysc = op == EventOp.SYSCALL
+    svc_tbl = jnp.asarray(params.syscall_cost_cycles, dtype=jnp.int32)
+    svc_ps = _lat(svc_tbl[jnp.clip(arg, 0, len(params.syscall_cost_cycles)
+                                   - 1)], p_core)
+    sys_req_ps = noc.unicast_ps(
+        params.net_user, rows, jnp.full((T,), mcp),
+        jnp.maximum(arg2, 0), p_nu, params.mesh_width)
+    dt_sysc = sys_req_ps + svc_ps + to_mcp_ps + cycle_ps
     nmod = state.period_ps.shape[1]
     mod_oh = is_dvfs[:, None] & dense.onehot(
         jnp.clip(arg, 0, nmod - 1), nmod)
@@ -662,6 +677,9 @@ def _complex_slot(params: SimParams, state: SimState,
     dt = jnp.where(is_unlock, dt_unlock, dt)
     dt = jnp.where(is_spawn, dt_spawn, dt)
     dt = jnp.where(is_dvfs, dt_dvfs, dt)
+    # ROI-gated like compute/memory: with models off a syscall still
+    # executes functionally but charges no simulated time.
+    dt = jnp.where(is_sysc & en, dt_sysc, dt)
 
     new_clock = clk + dt
     new_clock = jnp.where(
@@ -792,6 +810,8 @@ def _complex_slot(params: SimParams, state: SimState,
         cond_waits=add(c.cond_waits, is_cwait),
         cond_signals=add(c.cond_signals, is_csig | is_cbc),
         spawns=add(c.spawns, is_spawn),
+        syscalls=add(c.syscalls, is_sysc),
+        syscall_ps=c.syscall_ps + jnp.where(is_sysc & en, dt_sysc, 0),
     )
 
     st = st._replace(

@@ -826,6 +826,51 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             l1d=cachemod.invalidate_by_value(
                 state.l1d, dlv_lines, dlv_valid, dlv_tgt))
 
+        # ---- miss-type classification ([cache]/track_miss_types;
+        # reference cache.h:45-49): every served miss is sharing (the
+        # line was coherence-invalidated from this tile), capacity (seen
+        # before, evicted since), or cold (first touch).  Filters are
+        # direct-mapped per-tile line tables — a hash collision can
+        # misclassify one miss, never mistime anything.
+        if params.track_miss_types:
+            HF = state.seen_filter.shape[1]
+            fslot = (dense.fmix64(line) % jnp.uint64(HF)).astype(jnp.int32)
+            key32 = (line + 1).astype(jnp.int32)
+            seen_hit = jnp.take_along_axis(
+                state.seen_filter, fslot[:, None], axis=1)[:, 0] == key32
+            inv_hit = jnp.take_along_axis(
+                state.inv_filter, fslot[:, None], axis=1)[:, 0] == key32
+            m_shar = win & inv_hit
+            m_cap = win & ~inv_hit & seen_hit
+            m_cold = win & ~inv_hit & ~seen_hit
+            c2 = state.counters
+            state = state._replace(counters=c2._replace(
+                l2_miss_cold=c2.l2_miss_cold
+                + m_cold.astype(jnp.int64),
+                l2_miss_capacity=c2.l2_miss_capacity
+                + m_cap.astype(jnp.int64),
+                l2_miss_sharing=c2.l2_miss_sharing
+                + m_shar.astype(jnp.int64)))
+            rows_w = jnp.where(win, rows, T).astype(jnp.int32)
+            # The fill marks the line seen and consumes any inv mark.
+            state = state._replace(
+                seen_filter=state.seen_filter.at[rows_w, fslot].set(
+                    key32, mode="drop"),
+                inv_filter=state.inv_filter.at[
+                    jnp.where(m_shar, rows, T), fslot].set(
+                    0, mode="drop"))
+            # Record coherence take-aways: INV deliveries (down to I) mark
+            # the target's filter slot for the delivered line.
+            inv_dlv = dlv_valid & (dlv_tgt == I)
+            dlv_line_i = dlv_lines.astype(jnp.int64)
+            dslot = (dense.fmix64(dlv_line_i)
+                     % jnp.uint64(HF)).astype(jnp.int32)
+            tgt_rows = jnp.where(
+                inv_dlv, jnp.arange(T, dtype=jnp.int32)[:, None], T)
+            state = state._replace(
+                inv_filter=state.inv_filter.at[tgt_rows, dslot].set(
+                    (dlv_line_i + 1).astype(jnp.int32), mode="drop"))
+
         # ---- requester-side fills (private L2 then L1, or L1-only under
         # shared L2; L1D or L1I by request kind)
         if params.shared_l2:
@@ -1417,9 +1462,12 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
                   jnp.where(state.pend_kind == PEND_MUTEX,
                             state.pend_issue + to_mcp + 1,
                             state.pend_issue + 1)))
-    neg2 = jax.lax.top_k(-lb, 2)[0]
-    m1, m2 = -neg2[0], -neg2[1]
-    lb_excl = jnp.where(lb == m1, m2, m1)      # min over the OTHER tiles
+    if lb.shape[0] >= 2:
+        neg2 = jax.lax.top_k(-lb, 2)[0]
+        m1, m2 = -neg2[0], -neg2[1]
+        lb_excl = jnp.where(lb == m1, m2, m1)  # min over the OTHER tiles
+    else:
+        lb_excl = jnp.full_like(lb, INF)       # no other tiles exist
     woke_nc = dense.binsum(oh_c, wake & ~w_bc, 1) > 0
     woke_mine = _sel(oh_c, woke_nc.astype(jnp.int32)) > 0
     if params.cond_replay:

@@ -156,6 +156,10 @@ class SimSummary:
         lines.append("[l2_cache]")
         row("Cache Accesses", agg["l2_access"])
         row("Cache Misses", agg["l2_miss"])
+        if self.params.track_miss_types:
+            row("Cold Misses", agg["l2_miss_cold"])
+            row("Capacity Misses", agg["l2_miss_capacity"])
+            row("Sharing Misses", agg["l2_miss_sharing"])
         lines.append("[dram_directory]")
         row("Shared Requests", agg["dir_sh_req"])
         row("Exclusive Requests", agg["dir_ex_req"])
@@ -185,6 +189,10 @@ class SimSummary:
         lines.append("[threads]")
         row("Spawns", agg["spawns"])
         row("Joins", agg["joins"])
+        lines.append("[syscalls]")
+        row("Syscalls", agg["syscalls"])
+        row("Syscall Time (in ns, total)",
+            f"{ps_to_ns(agg['syscall_ps']):.1f}")
         lines.append("[stalls]")
         row("Memory Stall (in ns, total)", f"{ps_to_ns(agg['mem_stall_ps']):.1f}")
         row("Sync Stall (in ns, total)", f"{ps_to_ns(agg['sync_stall_ps']):.1f}")

@@ -96,6 +96,11 @@ class Counters(NamedTuple):
     cond_signals: jnp.ndarray        # signals + broadcasts posted
     spawns: jnp.ndarray              # SPAWN events issued by this tile
     joins: jnp.ndarray               # completed JOINs
+    syscalls: jnp.ndarray            # SYSCALL events served via the MCP
+    syscall_ps: jnp.ndarray          # time spent in syscall round trips
+    l2_miss_cold: jnp.ndarray        # miss-type classification (cache.h:
+    l2_miss_capacity: jnp.ndarray    #   45-49): first-touch / evicted /
+    l2_miss_sharing: jnp.ndarray     #   coherence-invalidated
     mem_stall_ps: jnp.ndarray        # time blocked on remote memory
     sync_stall_ps: jnp.ndarray       # time blocked on sync/recv
 
@@ -275,6 +280,16 @@ class SimState(NamedTuple):
     # bumped once per local round and per resolve conflict round)
     round_ctr: jnp.ndarray     # [] int32
 
+    # -- miss-type classification filters ([cache]/track_miss_types,
+    # reference cache.h:45-49 cold/capacity/sharing counters).  Per-tile
+    # direct-mapped line tables (fmix-hashed, last-writer-wins — a
+    # collision can misclassify one miss, never mistime anything):
+    # ``seen_filter`` records lines this tile has ever fetched,
+    # ``inv_filter`` lines taken away by coherence.  [1, 1] dummies when
+    # tracking is off.
+    seen_filter: jnp.ndarray   # [T, HF] int32 line id + 1 (0 = empty)
+    inv_filter: jnp.ndarray    # [T, HF] int32
+
     counters: Counters
 
     @property
@@ -308,6 +323,9 @@ def _dummy_cache(num_tiles: int) -> cachemod.CacheArrays:
 
 
 NUM_CONDS = 64      # cond-var id space (like max_mutexes; ids clip)
+MISS_FILTER_SLOTS = 1 << 14   # per-tile miss-type filter entries (2x the
+#                               T1 L2's 8192 lines: "seen" memory must
+#                               outlast the cache for capacity vs cold)
 
 
 def _nsamp(params: SimParams) -> int:
@@ -381,5 +399,11 @@ def make_state(params: SimParams,
         ch_time=jnp.zeros((channel_depth, T, T) if has_capi else (0, 0, 0),
                           dtype=jnp.int64),
         round_ctr=jnp.int32(0),
+        seen_filter=jnp.zeros(
+            (T, MISS_FILTER_SLOTS) if params.track_miss_types else (1, 1),
+            dtype=jnp.int32),
+        inv_filter=jnp.zeros(
+            (T, MISS_FILTER_SLOTS) if params.track_miss_types else (1, 1),
+            dtype=jnp.int32),
         counters=make_counters(T),
     )

@@ -66,10 +66,13 @@ def load_binary_trace(path: str, line_size: int = 64) -> Trace:
             touched.update(range(a >> PAGE_BITS,
                                  ((a + sz - 1) >> PAGE_BITS) + 1))
         fm = np.isin(rec["op"], ifetch_ops)
-        for a, n in zip(rec["addr"][fm], rec["arg2"][fm]):
-            a, span = int(a), max(1, int(n)) * 4   # ~4 B per instruction
-            touched.update(range(a >> PAGE_BITS,
-                                 ((a + span - 1) >> PAGE_BITS) + 1))
+        fa = rec["addr"][fm].astype(np.int64)
+        span = np.maximum(rec["arg2"][fm].astype(np.int64), 1) * 4
+        start = fa >> PAGE_BITS
+        end = (fa + span - 1) >> PAGE_BITS       # ~4 B per instruction
+        touched.update(np.unique(start).tolist())
+        for a, b in zip(start[start != end], end[start != end]):
+            touched.update(range(int(a), int(b) + 1))
     page_map = {p: i for i, p in enumerate(sorted(touched))}
 
     # ---- page-bounded splitting, per-piece remap, line splitting

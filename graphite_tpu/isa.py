@@ -87,6 +87,29 @@ class EventOp(enum.IntEnum):
                          # (CarbonEnableModels, simulator.cc:287-301)
     DISABLE_MODELS = 22  # region-of-interest end: fast-forward (zero cost,
                          # no counters) until re-enabled
+    SYSCALL = 23       # marshalled system call to the MCP's syscall server
+                       # (reference: common/tile/core/syscall_model.cc packs
+                       # args, common/system/syscall_server.cc:43-130 serves;
+                       # arg = SyscallClass, arg2 = marshalled byte count)
+
+
+class SyscallClass(enum.IntEnum):
+    """Syscall cost classes (reference: the IF_ORIG_ENUM dispatch table in
+    syscall_server.cc:43-130 — open/read/write/close/access/stat/mmap/brk
+    each marshal through the MCP; futex ops re-enter the sync machinery
+    and therefore surface as the sync events above, not as SYSCALL)."""
+
+    OTHER = 0
+    OPEN = 1
+    CLOSE = 2
+    READ = 3
+    WRITE = 4
+    LSEEK = 5
+    ACCESS = 6
+    STAT = 7
+    MMAP = 8
+    MUNMAP = 9
+    BRK = 10
 
 
 class MemComponent(enum.IntEnum):

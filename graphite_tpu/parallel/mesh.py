@@ -13,7 +13,10 @@ collectives riding ICI, and the quantum min-reduction is the barrier.
 Multi-host scaling rides the same mechanism: `jax.distributed` extends the
 mesh across hosts (ICI within a slice, DCN across), with no engine changes
 — the reference needed ssh spawners and a socket fabric for the same reach
-(tools/spawn_master.py).
+(tools/spawn_master.py).  Proven end to end by tools/multihost_dryrun.py
+(tests/test_multihost.py): two coordinator-connected processes run one
+fused megastep over a global 8-device mesh with collectives crossing the
+process boundary.
 """
 
 from __future__ import annotations

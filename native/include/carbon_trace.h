@@ -48,7 +48,25 @@ enum CarbonEventOp {
     CARBON_EV_JOIN = 19,
     CARBON_EV_THREAD_START = 20,
     CARBON_EV_ENABLE_MODELS = 21,
-    CARBON_EV_DISABLE_MODELS = 22
+    CARBON_EV_DISABLE_MODELS = 22,
+    CARBON_EV_SYSCALL = 23
+};
+
+/* Syscall cost classes (isa.SyscallClass; reference syscall_server.cc
+ * dispatch).  Futexes never surface here — pthread sync maps onto the
+ * sync events above. */
+enum CarbonSyscallClass {
+    CARBON_SYS_OTHER = 0,
+    CARBON_SYS_OPEN = 1,
+    CARBON_SYS_CLOSE = 2,
+    CARBON_SYS_READ = 3,
+    CARBON_SYS_WRITE = 4,
+    CARBON_SYS_LSEEK = 5,
+    CARBON_SYS_ACCESS = 6,
+    CARBON_SYS_STAT = 7,
+    CARBON_SYS_MMAP = 8,
+    CARBON_SYS_MUNMAP = 9,
+    CARBON_SYS_BRK = 10
 };
 
 /* ---- lifecycle (carbon_user.h) ---- */

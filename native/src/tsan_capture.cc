@@ -33,6 +33,7 @@
 
 #include "carbon_trace.h"
 
+#include <fcntl.h>
 #include <pthread.h>
 #include <stdint.h>
 #include <stdio.h>
@@ -42,6 +43,12 @@
 #include <mutex>
 
 extern "C" {
+long __real_read(int, void *, unsigned long);
+long __real_write(int, const void *, unsigned long);
+int __real_open(const char *, int, ...);
+int __real_close(int);
+long __real_lseek(int, long, int);
+int __real_access(const char *, int);
 int __real_pthread_create(pthread_t *, const pthread_attr_t *,
                           void *(*)(void *), void *);
 int __real_pthread_join(pthread_t, void **);
@@ -161,16 +168,21 @@ int __wrap_pthread_create(pthread_t *th, const pthread_attr_t *attr,
     Reent r;
     int tile = CarbonAllocTile();
     if (tile < 0) return __real_pthread_create(th, attr, fn, arg);
-    flush_compute();
-    CarbonEmitEvent(CARBON_EV_SPAWN, 0, 0, tile);
     Tram *t = new Tram{fn, arg, tile};
     int rc = __real_pthread_create(th, attr, trampoline, t);
     if (rc != 0) {
+        /* No SPAWN for a thread that never started (the tile id is
+         * consumed — ids are a monotone counter — but the trace stays
+         * consistent: no phantom child stream). */
         delete t;
         return rc;
     }
-    std::lock_guard<std::mutex> g(g_mu);
-    g_thread_tile[*th] = tile;
+    flush_compute();
+    CarbonEmitEvent(CARBON_EV_SPAWN, 0, 0, tile);
+    {
+        std::lock_guard<std::mutex> g(g_mu);
+        g_thread_tile[*th] = tile;
+    }
     return 0;
 }
 
@@ -182,7 +194,12 @@ int __wrap_pthread_join(pthread_t th, void **ret) {
     {
         std::lock_guard<std::mutex> g(g_mu);
         auto it = g_thread_tile.find(th);
-        if (it != g_thread_tile.end()) tile = it->second;
+        if (it != g_thread_tile.end()) {
+            tile = it->second;
+            /* pthread_t values are reused by the OS; a stale entry would
+             * attribute a later thread's join to this tile. */
+            g_thread_tile.erase(it);
+        }
     }
     if (tile >= 0) {
         flush_compute();
@@ -290,6 +307,65 @@ int __wrap_pthread_barrier_wait(pthread_barrier_t *b) {
         CarbonEmitEvent(CARBON_EV_BARRIER_WAIT, 0, obj_id(2, b), count);
     }
     return __real_pthread_barrier_wait(b);
+}
+
+/* ---- file-I/O interposition: direct libc calls record SYSCALL events
+ * (class + payload bytes) the engine prices as MCP syscall-server round
+ * trips (reference: syscall_model.cc marshalling).  Intra-libc calls
+ * (e.g. printf's internal write) bypass --wrap — like the reference's
+ * Pin tool, only application-level I/O is modeled. ---- */
+
+static void sys_event(int cls, long nbytes) {
+    if (tl_inside || !CarbonCaptureActive()) return;
+    Reent r;
+    flush_compute();
+    CarbonEmitEvent(CARBON_EV_SYSCALL, 0, cls,
+                    (int)(nbytes < 0 ? 0 : nbytes));
+}
+
+long __wrap_read(int fd, void *buf, unsigned long n) {
+    long r = __real_read(fd, buf, n);
+    sys_event(CARBON_SYS_READ, r);
+    return r;
+}
+
+long __wrap_write(int fd, const void *buf, unsigned long n) {
+    long r = __real_write(fd, buf, n);
+    sys_event(CARBON_SYS_WRITE, r);
+    return r;
+}
+
+int __wrap_open(const char *path, int flags, ...) {
+    /* The mode argument exists only for O_CREAT/O_TMPFILE calls; reading
+     * a never-passed vararg is UB. */
+    int mode = 0;
+    if (flags & (O_CREAT | O_TMPFILE)) {
+        __builtin_va_list ap;
+        __builtin_va_start(ap, flags);
+        mode = __builtin_va_arg(ap, int);
+        __builtin_va_end(ap);
+    }
+    int r = __real_open(path, flags, mode);
+    sys_event(CARBON_SYS_OPEN, 0);
+    return r;
+}
+
+int __wrap_close(int fd) {
+    int r = __real_close(fd);
+    sys_event(CARBON_SYS_CLOSE, 0);
+    return r;
+}
+
+long __wrap_lseek(int fd, long off, int whence) {
+    long r = __real_lseek(fd, off, whence);
+    sys_event(CARBON_SYS_LSEEK, 0);
+    return r;
+}
+
+int __wrap_access(const char *path, int mode) {
+    int r = __real_access(path, mode);
+    sys_event(CARBON_SYS_ACCESS, 0);
+    return r;
 }
 
 /* ---- TSan instrumentation hooks (the gcc -fsanitize=thread ABI) ---- */

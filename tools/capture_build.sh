@@ -15,7 +15,7 @@ make -s -C "$here/native" build/libcarbon_tsan.a
 WRAPS=(pthread_create pthread_join pthread_mutex_init pthread_mutex_lock
        pthread_mutex_unlock pthread_cond_init pthread_cond_wait
        pthread_cond_signal pthread_cond_broadcast pthread_barrier_init
-       pthread_barrier_wait)
+       pthread_barrier_wait read write open close lseek access)
 wrapflags=()
 for w in "${WRAPS[@]}"; do wrapflags+=("-Wl,--wrap,$w"); done
 
