@@ -24,7 +24,8 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 12  # v4: packed int32 cache/dir metadata layout;
+_SCHEMA_VERSION = 13  # v13: packed int64 dir_word (tag|stamp|owner|state);
+#   v4: packed int32 cache/dir metadata layout;
 #   v12: syscall counters;
 #   v11: [W*A, F] flat sharer planes;
 #   v10: packed int64 cache words (timestamp LRU), dir_stamp, round_ctr,

@@ -66,7 +66,7 @@ def _maybe_sample(params: SimParams, state: SimState) -> SimState:
     releases the same way — lax_barrier_sync_server.cc:157-159 notifying
     statistics_thread.cc; series list per statistics_manager.cc:41-114)."""
     from graphite_tpu.engine import cache as cachemod
-    from graphite_tpu.engine.state import dir_meta_state
+    from graphite_tpu.engine.state import dword_state
     S = state.stat_time.shape[0]
     interval = jnp.int64(params.stat_interval_ps)
     do = (state.boundary >= state.stat_next) & (state.stat_filled < S)
@@ -75,7 +75,7 @@ def _maybe_sample(params: SimParams, state: SimState) -> SimState:
         idx = jnp.minimum(st.stat_filled, S - 1)
         c = st.counters
         if params.shared_l2:
-            live = jnp.sum(dir_meta_state(st.dir_meta) != 0,
+            live = jnp.sum(dword_state(st.dir_word) != 0,
                            dtype=jnp.int64)
         else:
             live = jnp.sum(cachemod.meta_state(st.l2.meta) != 0,
@@ -105,16 +105,31 @@ def _maybe_sample(params: SimParams, state: SimState) -> SimState:
 
 def quantum_step(params: SimParams, state: SimState,
                  trace: TraceArrays) -> SimState:
-    """One barrier quantum: all tiles advance to the new boundary."""
+    """One barrier quantum: all tiles advance to the new boundary.
+
+    Sub-rounds of (local_advance ; resolve) repeat while they make
+    progress (any event retired or unblocked — the cursor sum moves),
+    capped at ``rounds_per_quantum``; quanta whose work drains in one
+    sub-round (most of them) pay for one instead of the full cap."""
     state = state._replace(boundary=next_boundary(params, state))
 
-    def sub_round(_, st):
+    def progress(st):
+        return jnp.sum(st.cursor.astype(jnp.int64))
+
+    def cond(carry):
+        i, prev, st = carry
+        return (i < params.rounds_per_quantum) \
+            & ((i == 0) | (progress(st) > prev))
+
+    def body(carry):
+        i, _prev, st = carry
+        p0 = progress(st)
         st = local_advance(params, st, trace)
         st = resolve(params, st)
-        return st
+        return i + 1, p0, st
 
-    state = jax.lax.fori_loop(0, params.rounds_per_quantum, sub_round,
-                              state)
+    _, _, state = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.int64(-1), state))
     if params.stats_enabled or params.progress_enabled:
         state = _maybe_sample(params, state)
     return state

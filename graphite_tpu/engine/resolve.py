@@ -35,7 +35,8 @@ from graphite_tpu.engine.core import STAMP_STRIDE, _lat, _period, mcp_tile
 from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
     PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
-    PEND_START, SimState, dir_meta_owner, dir_meta_state, dir_pack)
+    PEND_START, SimState, dword_owner, dword_pack, dword_stamp, dword_state,
+    dword_tag, dword_with_meta)
 from graphite_tpu.isa import DVFSModule
 from graphite_tpu.params import SimParams
 
@@ -284,12 +285,12 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             win = _elect(unres, packed, hidx, H)
 
         # ---- directory-cache probe at (home, dset), via the flat
-        # (home*ndsets + dset) index — one gather per field
-        dtags = state.dir_tags[:, fidx].T                    # [T, A]
-        dmeta = state.dir_meta[:, fidx].T
-        dstamp = state.dir_stamp[:, fidx].T
-        dstate = dir_meta_state(dmeta)
-        match = (dtags == line[:, None].astype(jnp.int32)) & (dstate != I)
+        # (home*ndsets + dset) index — ONE gather for the whole entry
+        drow = state.dir_word[:, fidx].T                     # [T, A]
+        dstate = dword_state(drow)
+        dstamp = dword_stamp(drow)
+        match = (dword_tag(drow) == line[:, None].astype(jnp.int32)) \
+            & (dstate != I)
         hit = match.any(axis=1)
         hway = jnp.argmax(match, axis=1).astype(jnp.int32)
         invalid = dstate == I
@@ -346,17 +347,16 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         win = win & ~alloc_defer
         misswin = misswin & ~alloc_defer
 
-        evicting = misswin & jnp.take_along_axis(
-            dstate != I, way[:, None], axis=1)[:, 0]
+        # The selected way's whole entry in one gather of the packed word.
+        way_word = jnp.take_along_axis(drow, way[:, None], axis=1)[:, 0]
+        way_state = dword_state(way_word)
+        way_owner = dword_owner(way_word)
+        evicting = misswin & (way_state != I)
 
-        downer = dir_meta_owner(dmeta)                        # [T, A]
         dsharers = state.dir_sharers[:, fidx].reshape(
             W, A, T).transpose(2, 1, 0)                       # [T, A, W]
-        entry_state = jnp.where(
-            hit, jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
-        entry_owner = jnp.where(
-            hit,
-            jnp.take_along_axis(downer, way[:, None], axis=1)[:, 0], -1)
+        entry_state = jnp.where(hit, way_state, I)
+        entry_owner = jnp.where(hit, way_owner, -1)
         entry_sharers = jnp.where(
             hit[:, None],
             jnp.take_along_axis(
@@ -367,12 +367,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # of the victim's sharers/owner on directory-cache replacement —
         # dram_directory_cntlr replacement path; leaving them cached would
         # let a later request grant M while stale copies still hit).
-        vtag = jnp.take_along_axis(
-            dtags, way[:, None], axis=1)[:, 0].astype(jnp.int64)
-        vstate = jnp.where(
-            evicting,
-            jnp.take_along_axis(dstate, way[:, None], axis=1)[:, 0], I)
-        vowner = jnp.take_along_axis(downer, way[:, None], axis=1)[:, 0]
+        vtag = dword_tag(way_word).astype(jnp.int64)
+        vstate = jnp.where(evicting, way_state, I)
+        vowner = way_owner
         vsharers = jnp.take_along_axis(
             dsharers, way[:, None, None], axis=1)[:, 0, :]
         # Owner-flush victims: M always; E too under shared-L2 MESI (the
@@ -770,19 +767,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # like engine/cache.py — the old code maintained rank permutations
         # with dense [T, T, A] merges).
         fidx_w = jnp.where(win, fidx, jnp.int32(2**30))
-        # (Combined SH winners of one line write identical tag/meta/stamp
-        # values, so their colliding scatters are safe; the sharer bitmap
-        # is the one per-winner-distinct field — the line's rep rewrites
-        # the row, then the other combined winners add their disjoint
-        # bits on top.)
+        # (Combined SH winners of one line write identical packed words,
+        # so their colliding scatters are safe; the sharer bitmap is the
+        # one per-winner-distinct field — the line's rep rewrites the row,
+        # then the other combined winners add their disjoint bits on top.)
         state = state._replace(
-            dir_tags=state.dir_tags.at[way, fidx_w].set(
-                line.astype(jnp.int32), mode="drop"),
-            dir_meta=state.dir_meta.at[way, fidx_w].set(
-                dir_pack(act.new_state, act.new_owner), mode="drop"),
-            dir_stamp=state.dir_stamp.at[way, fidx_w].set(
-                state.round_ctr, mode="drop"),
-        )
+            dir_word=state.dir_word.at[way, fidx_w].set(
+                dword_pack(line, state.round_ctr, act.new_state,
+                           act.new_owner), mode="drop"))
         # Sharer-bitmap rewrite as per-PLANE modular delta-adds: the slot's
         # current row is known (the hit entry's words, or the victim's for
         # a fresh alloc), so adding (new - old) lands the new row exactly —
@@ -1120,17 +1112,16 @@ class _VictimProbe:
         self.vdset = dir_set_of_line(params, vtag)
         self.vfidx = (self.vhome * ndsets + self.vdset).astype(jnp.int32)
         vfidx = self.vfidx
-        dtags = state.dir_tags[:, vfidx].T                  # [T, A]
-        dmeta = state.dir_meta[:, vfidx].T
-        dstate = dir_meta_state(dmeta)
-        match = (dtags == vtag[:, None].astype(jnp.int32)) \
+        drow = state.dir_word[:, vfidx].T                   # [T, A]
+        dstate = dword_state(drow)
+        match = (dword_tag(drow) == vtag[:, None].astype(jnp.int32)) \
             & (dstate != I) & valid[:, None]
         self.found = match.any(axis=1)
         self.way = jnp.argmax(match, axis=1).astype(jnp.int32)
-        self.meta_way = jnp.take_along_axis(
-            dmeta, self.way[:, None], axis=1)[:, 0]
-        self.est = dir_meta_state(self.meta_way)
-        self.eowner = dir_meta_owner(self.meta_way)
+        self.word_way = jnp.take_along_axis(
+            drow, self.way[:, None], axis=1)[:, 0]
+        self.est = dword_state(self.word_way)
+        self.eowner = dword_owner(self.word_way)
         self.esharers = jnp.sum(
             jnp.where((jnp.arange(A, dtype=jnp.int32)[:, None]
                        == self.way[None, :])[None, :, :],
@@ -1145,11 +1136,14 @@ class _VictimProbe:
         self.has_bit = (cur & self.bit) != jnp.uint64(0)
 
     def set_meta(self, state: SimState, mask, new_state, new_owner):
-        """Rewrite the matched entry's (state, owner) where ``mask``."""
+        """Rewrite the matched entry's (state, owner) where ``mask``
+        (tag + stamp preserved from the gathered word; callers pass
+        disjoint masks, so each entry is written at most once)."""
         f = jnp.where(mask, self.vfidx, jnp.int32(2**30))
         return state._replace(
-            dir_meta=state.dir_meta.at[self.way, f].set(
-                dir_pack(new_state, new_owner), mode="drop"))
+            dir_word=state.dir_word.at[self.way, f].set(
+                dword_with_meta(self.word_way, new_state, new_owner),
+                mode="drop"))
 
     def clear_bit(self, state: SimState, mask):
         """Clear the dropping tile's sharer bit where ``mask`` (guarded

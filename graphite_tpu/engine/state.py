@@ -146,11 +146,64 @@ class TraceArrays(NamedTuple):
 _DIR_OWNER_BITS = 13   # owner+1, supports up to 8191 tiles
 _DIR_OWNER_SHIFT = 3
 
+# Packed directory-entry word (int64), ONE array instead of the round-3
+# tags/meta/stamp triple — a directory probe is one gather and an entry
+# write one scatter (gather/scatter ops on this hardware cost per
+# *operation*, so collapsing 3 arrays into 1 cuts the conflict-round cost
+# by the same factor):
+#
+#     bits  0..2    entry state (I/S/O/E/M — directory_state.h roles)
+#     bits  3..15   owner tile + 1 (0 = none)
+#     bits 16..32   replacement stamp (17-bit wrapping round counter;
+#                   a wrap only perturbs LRU victim choice, never
+#                   correctness — same argument as cache.py STAMP_BITS)
+#     bits 33..63   tag (31-bit line id; frontend asserts addr < 2^37)
+#
+# Bits 0..15 are exactly the legacy int32 "meta" layout, so
+# dir_meta_state/dir_meta_owner keep working on the `dir_meta` view.
+DIR_STAMP_BITS = 17
+_DIR_STAMP_SHIFT = 16
+_DIR_STAMP_FIELD = (1 << DIR_STAMP_BITS) - 1
+_DIR_TAG_SHIFT = _DIR_STAMP_SHIFT + DIR_STAMP_BITS  # 33
+_DIR_META_MASK = (1 << _DIR_STAMP_SHIFT) - 1
+
+
+def dword_pack(tag, stamp, state, owner):
+    """(tag, stamp, state, owner) -> packed int64 directory word."""
+    return (jnp.asarray(tag, jnp.int64) << _DIR_TAG_SHIFT) \
+        | ((jnp.asarray(stamp, jnp.int64) & _DIR_STAMP_FIELD)
+           << _DIR_STAMP_SHIFT) \
+        | ((jnp.asarray(owner, jnp.int64) + 1) << _DIR_OWNER_SHIFT) \
+        | jnp.asarray(state, jnp.int64)
+
+
+def dword_state(word):
+    return (word & 7).astype(jnp.int32)
+
+
+def dword_owner(word):
+    return (((word >> _DIR_OWNER_SHIFT)
+             & ((1 << _DIR_OWNER_BITS) - 1)) - 1).astype(jnp.int32)
+
+
+def dword_stamp(word):
+    return ((word >> _DIR_STAMP_SHIFT) & _DIR_STAMP_FIELD).astype(jnp.int32)
+
+
+def dword_tag(word):
+    return (word >> _DIR_TAG_SHIFT).astype(jnp.int32)
+
+
+def dword_with_meta(word, state, owner):
+    """Replace the (state, owner) fields, keeping tag + stamp."""
+    return (word & ~jnp.int64(_DIR_META_MASK)) \
+        | ((jnp.asarray(owner, jnp.int64) + 1) << _DIR_OWNER_SHIFT) \
+        | jnp.asarray(state, jnp.int64)
+
 
 def dir_pack(state, owner, lru=0):
-    """Pack directory-entry (state, owner tile) into one int32.  The
-    replacement stamp lives in the separate ``dir_stamp`` array (see
-    SimState); the legacy ``lru`` argument is accepted and ignored."""
+    """Legacy int32 'meta' word (state | owner+1 << 3) — the low 16 bits
+    of the packed dir_word; kept for tests/tools."""
     del lru
     return (jnp.asarray(state, jnp.int32)
             | ((jnp.asarray(owner, jnp.int32) + 1) << _DIR_OWNER_SHIFT))
@@ -197,19 +250,14 @@ class SimState(NamedTuple):
     period_ps: jnp.ndarray    # [T, NUM_DVFS_MODULES] int32 ps per cycle
 
     # -- directory slices (home-tile-indexed; reference: directory_cache.cc)
-    # Entry metadata is packed into one int32 word (see dir_pack/
-    # dir_meta_*): the engine is HBM-bound and separate state/owner arrays
-    # doubled the per-round directory traffic.  The (tile, set) axes are
-    # stored PRE-FLATTENED — every access indexes by the flat
-    # home*ndsets + dset id, and a [.., T, dsets] layout forced XLA to
-    # materialize a full-array reshape copy per conflict round (profiled
-    # at ~4.5 ms per round on the 512 MB 1024-tile sharer bitmap).
-    dir_tags: jnp.ndarray     # [dassoc, T*dsets] int32 line id
-    dir_meta: jnp.ndarray     # [dassoc, T*dsets] int32 packed
-    #   (state bits 0-2 | owner+1 bits 3-15)
-    dir_stamp: jnp.ndarray    # [dassoc, T*dsets] int32 replacement stamp
-    #   (monotone access counter; victim = min-stamp way — true LRU, in
-    #   scatter-friendly timestamp form like engine/cache.py)
+    # The whole entry (tag | stamp | owner | state) is packed into ONE
+    # int64 word (see dword_pack): a probe is one gather, a write one
+    # scatter.  The (tile, set) axes are stored PRE-FLATTENED — every
+    # access indexes by the flat home*ndsets + dset id, and a
+    # [.., T, dsets] layout forced XLA to materialize a full-array reshape
+    # copy per conflict round (profiled at ~4.5 ms per round on the 512 MB
+    # 1024-tile sharer bitmap).
+    dir_word: jnp.ndarray     # [dassoc, T*dsets] int64 packed entries
     dir_sharers: jnp.ndarray  # [W*dassoc, T*dsets] uint64 sharer bitmaps —
     #   plane (w, way) lives at row w*dassoc + way.  Two-dimensional so
     #   every sharer update is a (row, col)-indexed single-word scatter;
@@ -297,6 +345,21 @@ class SimState(NamedTuple):
         """Static: were CAPI channel arrays allocated for this run?"""
         return self.ch_sent.size > 0
 
+    # Unpacked directory views (tests/tools; the engine reads dir_word).
+    @property
+    def dir_tags(self) -> jnp.ndarray:
+        return dword_tag(self.dir_word)
+
+    @property
+    def dir_meta(self) -> jnp.ndarray:
+        """Legacy int32 meta view (state | owner+1 << 3) — feed to
+        dir_meta_state / dir_meta_owner."""
+        return (self.dir_word & _DIR_META_MASK).astype(jnp.int32)
+
+    @property
+    def dir_stamp(self) -> jnp.ndarray:
+        return dword_stamp(self.dir_word)
+
 
 def dir_sharers_view(state: "SimState", assoc: int) -> jnp.ndarray:
     """[W*A, F] flat sharer planes -> [A, F, W] word-minor view (for tests
@@ -365,11 +428,8 @@ def make_state(params: SimParams,
         l2=(_dummy_cache(T) if params.shared_l2
             else cachemod.make_cache(T, params.l2)),
         period_ps=jnp.asarray(init_periods(params)),
-        dir_tags=jnp.zeros(d_shape, dtype=jnp.int32),
-        dir_meta=dir_pack(
-            jnp.zeros(d_shape, dtype=jnp.int32),
-            jnp.full(d_shape, -1, dtype=jnp.int32)),
-        dir_stamp=jnp.zeros(d_shape, dtype=jnp.int32),
+        # I-state, owner -1, tag/stamp 0 packs to the all-zeros word.
+        dir_word=jnp.zeros(d_shape, dtype=jnp.int64),
         dir_sharers=jnp.zeros((W * d_shape[0], d_shape[1]),
                               dtype=jnp.uint64),
         lq_ready=jnp.zeros((params.core.load_queue_entries, T),

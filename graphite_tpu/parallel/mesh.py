@@ -46,8 +46,7 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
 # == num_tiles).
 _TILE_AXIS_BY_FIELD = {
     "word": 1, "meta": 1,            # CacheArrays [A, T, sets] / trace
-    "dir_tags": 1, "dir_meta": 1,    # [A, T*dsets] (tile-major flat)
-    "dir_stamp": 1,
+    "dir_word": 1,                   # [A, T*dsets] (tile-major flat)
     "dir_sharers": 1,                # [W*A, T*dsets]
     "ch_time": 1,                    # [D, T, T]
     "lq_ready": 1, "sq_ready": 1,    # [entries, T]
@@ -58,7 +57,7 @@ _TILE_AXIS_BY_FIELD = {
 # Fields whose tile axis is FLATTENED with a per-tile structural axis
 # (directory sets): tile-major, so an even split over the flat axis is an
 # even split over tiles.
-_TILE_MAJOR_FLAT = {"dir_tags", "dir_meta", "dir_stamp", "dir_sharers"}
+_TILE_MAJOR_FLAT = {"dir_word", "dir_sharers"}
 
 
 def tile_sharding(mesh: Mesh, num_tiles: int):
