@@ -284,6 +284,32 @@ def downgrade_lines(cache: CacheArrays, tiles: jnp.ndarray,
         way, _drop_rows(tiles, hit), sidx].min(new_word, mode="drop"))
 
 
+def raise_line_state(cache: CacheArrays, tiles: jnp.ndarray,
+                     lines: jnp.ndarray, valid: jnp.ndarray,
+                     up_state, num_sets: int) -> CacheArrays:
+    """Raise a resident line's state in place (scatter-max on the packed
+    word — tag and stamp unchanged, so a raise can never lose to a
+    concurrent touch of the same line).  Used for the MESI E grant to a
+    chain winner whose read was optimistically installed as S at bank
+    time (engine/resolve.py); a line already invalidated by a racing
+    coherence delivery is simply not found — the grant is dropped."""
+    sidx = set_index(lines, num_sets)
+    tiles = tiles.astype(jnp.int32)
+    flat = tiles * num_sets + sidx
+    A = cache.word.shape[0]
+    row = cache.word.reshape(A, -1)[:, flat]          # [A, R]
+    st_row = word_state(row)
+    match = (word_tag(row) == lines[None].astype(jnp.int32)) \
+        & (st_row != I) & valid[None]
+    hit = match.any(axis=0)
+    way = jnp.argmax(match, axis=0).astype(jnp.int32)
+    cur = jnp.take_along_axis(row, way[None], axis=0)[0]
+    new_word = with_state(cur, jnp.maximum(word_state(cur),
+                                           jnp.asarray(up_state, jnp.int32)))
+    return cache._replace(word=cache.word.at[
+        way, _drop_rows(tiles, hit), sidx].max(new_word, mode="drop"))
+
+
 def invalidate_by_value(cache: CacheArrays, lines: jnp.ndarray,
                         valid: jnp.ndarray,
                         down_state: jnp.ndarray) -> CacheArrays:

@@ -92,17 +92,38 @@ def _row_word(row: jnp.ndarray, way: jnp.ndarray) -> jnp.ndarray:
 
 def _block_retire(params: SimParams, st: SimState,
                   trace: TraceArrays) -> SimState:
-    """Retire the leading run of simple events in each tile's [K] window."""
+    """Retire the leading run of simple events in each tile's [K] window.
+
+    With ``tpu/miss_chain`` > 0 the window also executes PAST L2 misses
+    (the round-4 perf design): a missing line is installed optimistically
+    at its requested state, the request is banked into the tile's miss
+    chain (mq_*; engine/state.py) with the local time since the previous
+    chain element recorded as its issue delta, and execution continues on
+    a RELATIVE clock.  One resolve pass later prices the whole chain in
+    FCFS order — so a tile costs ~one device round per chain instead of
+    one per miss.  Events needing an absolute clock (STALL/SYNC floors,
+    SPAWN, iocoom drains) retire only on an empty chain; everything else
+    (compute/branch/hits/local fills/further misses) rides the relative
+    clock.  In-order timing is exact: the core stalls on each miss, so
+    the continuation point of element k is its completion, and later
+    events' times are completion + accumulated local dt.
+    """
     K = params.block_events
     T = params.num_tiles
     N = trace.num_events
+    P = params.miss_chain
     line_bits = params.line_size.bit_length() - 1
     rows = jnp.arange(T)
     shared_l2 = params.shared_l2
     mesi_local = params.protocol_kind == "sh_l2_mesi"
 
+    nm0 = st.mq_count if P > 0 else jnp.zeros(T, dtype=jnp.int32)
+    in_chain = nm0 > 0
+    # Mid-chain tiles run on the relative clock: the boundary check moves
+    # to the per-event prefix (rel < quantum bounds the overrun past the
+    # unknown completion to one quantum of skew — the lax model's slack).
     tile_active = (~st.done) & (st.pend_kind == PEND_NONE) \
-        & (st.clock < st.boundary) & (st.cursor < N)
+        & (in_chain | (st.clock < st.boundary)) & (st.cursor < N)
 
     # ---- window gather: next K events per tile (two gathers)
     pos = st.cursor[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
@@ -154,6 +175,19 @@ def _block_retire(params: SimParams, st: SimState,
     fill_d = mem_l2                           # L1D fill from local L2 hit
     fill_i = comp_l2                          # L1I fill from local L2 hit
 
+    # Bankable misses (miss both levels, or a write upgrade of a
+    # non-writable resident line) — retire by banking when chain slots
+    # remain.  Atomics stay complex (drain points).
+    if P > 0:
+        mem_bank = is_mem & ~l1_ok & ~mem_l2
+        comp_bank = is_comp & ~pI.hit & ~comp_l2
+        fill_bank_d = mem_bank                # L1D install at bank time
+        fill_bank_i = comp_bank               # L1I install
+    else:
+        mem_bank = jnp.zeros_like(l1_ok)
+        comp_bank = jnp.zeros_like(l1_ok)
+        fill_bank_d = fill_bank_i = mem_bank
+
     # iocoom drain: branches are drain points without speculative loads —
     # the drain floor (max outstanding LQ/SQ completion) is constant over
     # the window (rings only change in resolve), so it folds into the
@@ -191,22 +225,65 @@ def _block_retire(params: SimParams, st: SimState,
     touch_i = is_comp & pI.hit
     upg_d = touch_d & is_wr & (pD.state == E) if mesi_local \
         else jnp.zeros_like(touch_d)
-    haz_d = _hazard(fill_d | upg_d, is_mem, pD.set_idx) \
-        | _hazard(touch_d | fill_d, fill_d, pD.set_idx)
-    haz_i = _hazard(fill_i, is_comp, pI.set_idx) \
-        | _hazard(touch_i | fill_i, fill_i, pI.set_idx)
+    all_fill_d = fill_d | fill_bank_d
+    all_fill_i = fill_i | fill_bank_i
+    haz_d = _hazard(all_fill_d | upg_d, is_mem, pD.set_idx) \
+        | _hazard(touch_d | all_fill_d, all_fill_d, pD.set_idx)
+    haz_i = _hazard(all_fill_i, is_comp, pI.set_idx) \
+        | _hazard(touch_i | all_fill_i, all_fill_i, pI.set_idx)
     hazard = haz_d | haz_i
 
-    simple_en = comp_simple | is_br | mem_simple | is_stall | is_sync \
-        | is_spawn
-    # Models disabled: the window retires NOTHING — tiles go one event per
-    # general slot, exactly the round-2 lockstep.  ROI markers
-    # (ENABLE/DISABLE_MODELS) are slot-synchronized across tiles in the
-    # reference's broadcast sense; letting tiles fast-forward K events per
-    # round while the flag is off races them past their own ENABLE point
-    # relative to other tiles (caught by test_roi_gates_counters_and_time).
-    simple = jnp.where(en, simple_en & ~hazard, False)
-    ok = valid_ev & simple
+    # L2 install candidates (private): chosen way + victim from the
+    # window-start rows, used for the L2 set/value hazards, the post-loop
+    # install scatter, and the banked victim record.
+    l2_fill_cand = mem_bank | comp_bank
+    if P > 0 and not shared_l2:
+        A2 = st.l2.word.shape[0]
+        st_row2 = cachemod.word_state(pL2.row)            # [A2, T, K]
+        inv2 = st_row2 == I
+        has_inv2 = inv2.any(axis=0)
+        first_inv2 = jnp.argmax(inv2, axis=0)
+        if params.l2.replacement == "round_robin":
+            rr2 = jnp.take_along_axis(st.l2.rr_ptr, pL2.set_idx, axis=1)
+            pol2 = rr2 % A2
+        else:
+            pol2 = jnp.argmin(cachemod.word_stamp(pL2.row), axis=0)
+        vic_way2 = jnp.where(has_inv2, first_inv2, pol2)
+        # Resident upgrade (EX to a non-writable resident line) installs
+        # in place — no victim.
+        fway2 = jnp.where(pL2.hit, pL2.way, vic_way2).astype(jnp.int32)
+        vic_word2 = _row_word(pL2.row, fway2)
+        l2_vic_tag = cachemod.word_tag(vic_word2).astype(jnp.int64)
+        l2_vic_state = jnp.where(pL2.hit, I, cachemod.word_state(vic_word2))
+        # L2 hazards: any L2-consulting event after a same-L2-set install
+        # (and any install after a same-set consult — victim staleness).
+        l2_probing = is_mem | is_comp
+        hazard = hazard \
+            | _hazard(l2_fill_cand, l2_probing, pL2.set_idx) \
+            | _hazard(l2_probing | l2_fill_cand, l2_fill_cand, pL2.set_idx)
+        # Inclusion value hazard: an install drops its L2 victim's L1D
+        # copy, so a later event L1-hitting the victim LINE must not
+        # retire against the stale window-start probe.
+        vic_live_c = (l2_vic_state != I) & l2_fill_cand
+        hazard = hazard | (
+            (is_mem & l1_ok)
+            & (earlier & (l2_vic_tag[:, None, :] == line[:, :, None])
+               & vic_live_c[:, None, :]).any(axis=2))
+
+    # Retire classes.  Models disabled: the window retires NOTHING — tiles
+    # go one event per general slot, exactly the round-2 lockstep.  ROI
+    # markers (ENABLE/DISABLE_MODELS) are slot-synchronized across tiles in
+    # the reference's broadcast sense; letting tiles fast-forward K events
+    # per round while the flag is off races them past their own ENABLE
+    # point relative to other tiles (test_roi_gates_counters_and_time).
+    br_abs = iocoom and not params.core.speculative_loads
+    base_ok = valid_ev & ~hazard & en
+    ok_rel = (comp_simple | mem_simple
+              | (jnp.zeros_like(is_br) if br_abs else is_br)) & base_ok
+    ok_abs = (is_stall | is_sync | is_spawn
+              | (is_br if br_abs else jnp.zeros_like(is_br))) & base_ok
+    ok_bank = (mem_bank | comp_bank) & base_ok
+    ok = ok_rel | ok_abs | ok_bank            # retire-capable (BP masking)
 
     # ---- branch predictor: within-window read-after-write on table slots
     if params.core.bp_type == "none":
@@ -259,15 +336,51 @@ def _block_retire(params: SimParams, st: SimState,
         floor = jnp.where(drain_ev, jnp.maximum(floor, drain_t), floor)
 
     # ---- max-plus prefix: clk_{j+1} = max(clk_j, floor_j) + dt_j over the
-    # retired prefix, stopping at the boundary (clk before event < boundary)
+    # retired prefix.  With chaining, a banked miss switches the tile to
+    # the RELATIVE clock (rel since the unknown completion); absolute-only
+    # events then stop the prefix until the chain drains.  Boundary check:
+    # absolute clock against the quantum boundary, or rel against one
+    # quantum of post-miss overrun.
+    qps = jnp.int64(params.quantum_ps)
+    # Request-issue offset (local tag checks before the request leaves —
+    # complex-slot `issue` math): L1 access + L2 tag check (L1-only under
+    # shared L2).
+    miss_tags_ps = cycle_ps if shared_l2 else \
+        _lat(params.l2.tags_access_cycles, p_l2)
+    issue_off = jnp.where(is_comp, l1i_ps, l1d_ps) + miss_tags_ps
     clk = st.clock
+    rel = st.chain_rel if P > 0 else jnp.zeros(T, dtype=jnp.int64)
+    nm = nm0
     n_ret = jnp.zeros(T, dtype=jnp.int32)
     run = tile_active
     clks = []
+    bank_marks, bank_slots, bank_deltas = [], [], []
     for j in range(K):
         clks.append(clk)                     # clock BEFORE event j
-        can = run & ok[:, j] & (clk < st.boundary)
-        clk = jnp.where(can, jnp.maximum(clk, floor[:, j]) + dt[:, j], clk)
+        if P > 0:
+            bank_j = ok_bank[:, j] & (nm < P)
+            okj = ok_rel[:, j] | (ok_abs[:, j] & (nm == 0)) | bank_j
+            in_b = jnp.where(nm == 0, clk < st.boundary, rel < qps)
+        else:
+            bank_j = jnp.zeros(T, dtype=bool)
+            okj = ok_rel[:, j] | ok_abs[:, j]
+            in_b = clk < st.boundary
+        can = run & okj & in_b
+        bankc = can & bank_j
+        if P > 0:
+            bank_marks.append(bankc)
+            bank_slots.append(nm)
+            bank_deltas.append(
+                jnp.where(nm == 0, clk, rel) + issue_off[:, j])
+            abs_step = can & (nm == 0) & ~bankc
+            rel_step = can & (nm > 0) & ~bankc
+            rel = jnp.where(bankc, 0,
+                            jnp.where(rel_step, rel + dt[:, j], rel))
+            nm = nm + bankc.astype(jnp.int32)
+        else:
+            abs_step = can
+        clk = jnp.where(abs_step,
+                        jnp.maximum(clk, floor[:, j]) + dt[:, j], clk)
         n_ret = n_ret + can.astype(jnp.int32)
         run = can
     clk_before = jnp.stack(clks, axis=1)                      # [T, K]
@@ -307,49 +420,81 @@ def _block_retire(params: SimParams, st: SimState,
         l2 = cachemod.touch(st.l2, pL2.set_idx, pL2.way,
                             (mem_l2 | comp_l2) & retired & enb,
                             _row_word(pL2.row, pL2.way), stamp)
-        # Window fills from local L2 hits, all at once: the hazard rules
-        # guarantee distinct sets per window, so the [T, K] scatter can't
-        # collide, victim picks from window-start stamps are exact, and
-        # victims fold into the inclusive L2 copy (timing-only, as in the
-        # round-2 engine — no writeback bookkeeping on this path).
-        def _apply_fills(cache, fills, probe, fill_state, cp):
-            act = fills & retired & enb
-            st_row = cachemod.word_state(probe.row)       # [A, T, K]
-            invalid = st_row == cachemod.I
-            has_inv = invalid.any(axis=0)
-            first_inv = jnp.argmax(invalid, axis=0)
-            lru_way = jnp.argmin(cachemod.word_stamp(probe.row), axis=0)
-            vic_way = jnp.where(has_inv, first_inv, lru_way)
-            # Resident upgrade (a write to an S-line whose M copy sits in
-            # L2 re-installs in place) keeps the probe's way.
-            fway = jnp.where(probe.hit, probe.way,
-                             vic_way).astype(jnp.int32)
-            new_word = cachemod.pack_word(
-                line.astype(jnp.int32), stamp, fill_state)
-            if cp.replacement == "round_robin":
-                # Pointer advances on EVERY non-resident install (even
-                # into an invalid way) — must match cachemod.fill, the
-                # complex-slot/resolve path, or victim choices diverge
-                # between block_events settings.
-                adv = act & ~probe.hit
-                rr = jnp.take_along_axis(cache.rr_ptr, probe.set_idx,
-                                         axis=1)
-                A = cache.word.shape[0]
-                fway = jnp.where(probe.hit, probe.way,
-                                 jnp.where(has_inv, first_inv, rr % A))
-                cache = cache._replace(rr_ptr=cache.rr_ptr.at[
-                    jnp.where(adv, rows[:, None], T), probe.set_idx].set(
-                    (rr + 1) % A, mode="drop"))
-            return cache._replace(word=cache.word.at[
-                fway, jnp.where(act, rows[:, None], T), probe.set_idx].set(
-                new_word, mode="drop"))
 
-        l1d = _apply_fills(l1d, fill_d, pD,
-                           jnp.where(is_wr, M, S).astype(jnp.int32),
-                           params.l1d)
-        l1i = _apply_fills(l1i, fill_i, pI,
-                           jnp.full((T, K), S, dtype=jnp.int32),
-                           params.l1i)
+    # Window fills — L1 fills from local L2 hits AND banked-miss installs,
+    # all at once: the hazard rules guarantee distinct sets per window, so
+    # the [T, K] scatter can't collide, victim picks from window-start
+    # stamps are exact, and (private protocols) L1 victims fold into the
+    # inclusive L2 copy (timing-only, as in the round-2 engine).  Returns
+    # the per-event victim (tag, state) for the banked-victim record
+    # (meaningful where the fill allocated a way).
+    def _apply_fills(cache, fills, probe, fill_state, cp):
+        act = fills & retired & enb
+        st_row = cachemod.word_state(probe.row)       # [A, T, K]
+        invalid = st_row == cachemod.I
+        has_inv = invalid.any(axis=0)
+        first_inv = jnp.argmax(invalid, axis=0)
+        lru_way = jnp.argmin(cachemod.word_stamp(probe.row), axis=0)
+        vic_way = jnp.where(has_inv, first_inv, lru_way)
+        # Resident upgrade (a write to an S-line whose M copy sits in
+        # L2 re-installs in place) keeps the probe's way.
+        fway = jnp.where(probe.hit, probe.way,
+                         vic_way).astype(jnp.int32)
+        new_word = cachemod.pack_word(
+            line.astype(jnp.int32), stamp, fill_state)
+        if cp.replacement == "round_robin":
+            # Pointer advances on EVERY non-resident install (even
+            # into an invalid way) — must match cachemod.fill, the
+            # complex-slot/resolve path, or victim choices diverge
+            # between block_events settings.
+            adv = act & ~probe.hit
+            rr = jnp.take_along_axis(cache.rr_ptr, probe.set_idx,
+                                     axis=1)
+            A = cache.word.shape[0]
+            fway = jnp.where(probe.hit, probe.way,
+                             jnp.where(has_inv, first_inv, rr % A))
+            cache = cache._replace(rr_ptr=cache.rr_ptr.at[
+                jnp.where(adv, rows[:, None], T), probe.set_idx].set(
+                (rr + 1) % A, mode="drop"))
+        vic_word = _row_word(probe.row, fway)
+        vic_tag = cachemod.word_tag(vic_word).astype(jnp.int64)
+        vic_state = jnp.where(probe.hit, I, cachemod.word_state(vic_word))
+        cache = cache._replace(word=cache.word.at[
+            fway, jnp.where(act, rows[:, None], T), probe.set_idx].set(
+            new_word, mode="drop"))
+        return cache, vic_tag, vic_state
+
+    if P > 0 or not shared_l2:
+        l1d, vicD_tag, vicD_state = _apply_fills(
+            l1d, fill_d | fill_bank_d, pD,
+            jnp.where(is_wr, M, S).astype(jnp.int32), params.l1d)
+        l1i, vicI_tag, vicI_state = _apply_fills(
+            l1i, fill_i | fill_bank_i, pI,
+            jnp.full((T, K), S, dtype=jnp.int32), params.l1i)
+
+    if P > 0 and not shared_l2:
+        # Banked-miss installs into the private L2 (way/victim chosen
+        # pre-loop from window-start rows; distinct sets per window by the
+        # hazard rules).
+        l2_fill_act = l2_fill_cand & retired & enb
+        l2_new_state = jnp.where(is_comp, S,
+                                 jnp.where(is_wr, M, S)).astype(jnp.int32)
+        new_word2 = cachemod.pack_word(line.astype(jnp.int32), stamp,
+                                       l2_new_state)
+        rows2 = jnp.broadcast_to(rows[:, None], (T, K))
+        l2 = l2._replace(word=l2.word.at[
+            fway2, jnp.where(l2_fill_act, rows2, T), pL2.set_idx].set(
+            new_word2, mode="drop"))
+        if params.l2.replacement == "round_robin":
+            adv2 = l2_fill_act & ~pL2.hit
+            l2 = l2._replace(rr_ptr=l2.rr_ptr.at[
+                jnp.where(adv2, rows2, T), pL2.set_idx].set(
+                (rr2 + 1) % A2, mode="drop"))
+        # Inclusion: the L2 victim's L1D copy drops now (the directory
+        # learns of the eviction when the banked element is served).
+        l1d = cachemod.invalidate_by_value(
+            l1d, l2_vic_tag, l2_fill_act & (l2_vic_state != I),
+            jnp.full((T, K), I, dtype=jnp.int32))
 
     # ---- branch-predictor table: last retired write per slot wins
     bp_table = st.bp_table
@@ -358,9 +503,20 @@ def _block_retire(params: SimParams, st: SimState,
         later_same = (earlier.transpose(0, 2, 1) & same_slot
                       & wr_ev[:, None, :]).any(axis=2)
         winner = wr_ev & ~later_same
-        bp_table = bp_table.at[
-            rows[:, None], jnp.where(winner, bidx, params.core.bp_size)
-        ].set(taken, mode="drop")
+        SZ = params.core.bp_size
+        if T * K * SZ <= dense.DENSE_MAX_ELEMS:
+            # Dense [T, K, SZ] masked update — the scatter form lowers to
+            # a serialized sort on TPU ([T, K] 2-D indices).
+            oh = (bidx[:, :, None]
+                  == jnp.arange(SZ, dtype=jnp.int32)[None, None, :]) \
+                & winner[:, :, None]
+            wrote = oh.any(axis=1)
+            val = (oh & taken[:, :, None]).any(axis=1)
+            bp_table = jnp.where(wrote, val, bp_table)
+        else:
+            bp_table = bp_table.at[
+                rows[:, None], jnp.where(winner, bidx, SZ)
+            ].set(taken, mode="drop")
 
     # ---- counters
     c = st.counters
@@ -381,22 +537,68 @@ def _block_retire(params: SimParams, st: SimState,
         l1d_write=c.l1d_write + msum(is_wr),
         l1d_write_miss=c.l1d_write_miss + msum(is_wr & ~l1_ok),
         l2_access=c.l2_access if shared_l2
-        else c.l2_access + msum(mem_l2 | comp_l2),
-        l2_miss=c.l2_miss,
+        else c.l2_access + msum(mem_l2 | comp_l2 | l2_fill_cand),
+        l2_miss=c.l2_miss if shared_l2
+        else c.l2_miss + msum(l2_fill_cand),
         branches=c.branches + msum(is_br),
         mispredicts=c.mispredicts + msum(is_br & ~correct),
         spawns=c.spawns + msum(is_spawn),
     )
 
-    return st._replace(
+    st = st._replace(
         clock=clk,
         cursor=st.cursor + n_ret,
         l1i=l1i, l1d=l1d, l2=l2,
         bp_table=bp_table,
         spawned_at=spawned_at,
         round_ctr=st.round_ctr + 1,
+        ctr_window=st.ctr_window + 1,
         counters=c,
     )
+
+    # ---- record banked chain elements ([T, K] window results -> the
+    # [P, T] chain arrays, via a dense slot one-hot — no scatter ops).
+    if P > 0:
+        bank_mark = jnp.stack(bank_marks, axis=1)    # [T, K]
+        bank_slot = jnp.stack(bank_slots, axis=1)
+        bank_delta = jnp.stack(bank_deltas, axis=1)
+        kind_ev = jnp.where(is_comp, PEND_IFETCH,
+                            jnp.where(is_wr, PEND_EX_REQ, PEND_SH_REQ))
+        req_val = kind_ev.astype(jnp.int64) | (line << 8)
+        if shared_l2:
+            vic_tag_v = jnp.where(is_comp, vicI_tag, vicD_tag)
+            vic_state_v = jnp.where(is_comp, vicI_state, vicD_state)
+        else:
+            vic_tag_v = l2_vic_tag
+            vic_state_v = l2_vic_state
+        vic_val = vic_state_v.astype(jnp.int64) | (vic_tag_v << 3)
+        # Local cost folded into the served completion (complex-slot
+        # `extra` math): a blocked COMPUTE's execution + fetch time minus
+        # the remotely fetched first line; memory operands owe nothing
+        # (atomics never bank).
+        extra_val = jnp.where(
+            is_comp,
+            cost_ps + fetch_ps
+            + (0 if shared_l2 else (n_lines - 1) * l2_ps),
+            jnp.int64(0))
+        slot_oh = (bank_slot[None] == jnp.arange(P)[:, None, None]) \
+            & bank_mark[None]                        # [P, T, K]
+        anyb = slot_oh.any(axis=2)
+
+        def put(dst, val):
+            v = jnp.sum(jnp.where(slot_oh, val[None], 0),
+                        axis=2).astype(dst.dtype)
+            return jnp.where(anyb, v, dst)
+
+        st = st._replace(
+            mq_req=put(st.mq_req, req_val),
+            mq_victim=put(st.mq_victim, vic_val),
+            mq_delta=put(st.mq_delta, bank_delta),
+            mq_extra=put(st.mq_extra, extra_val),
+            mq_count=nm,
+            chain_rel=jnp.where(nm > 0, rel, 0),
+        )
+    return st
 
 
 # ======================================================== complex slot
@@ -416,6 +618,10 @@ def _complex_slot(params: SimParams, state: SimState,
 
     active = (~st.done) & (st.pend_kind == PEND_NONE) \
         & (st.clock < st.boundary) & (st.cursor < N)
+    if params.miss_chain > 0:
+        # Complex events need an absolute clock — a tile with banked
+        # chain elements waits for the resolve pass to drain them.
+        active = active & (st.mq_count == 0)
     cur = jnp.minimum(st.cursor, N - 1)
     ev = trace.meta[:, rows, cur]          # [3, T] one fused gather
     addr = trace.addr[rows, cur]
@@ -690,8 +896,15 @@ def _complex_slot(params: SimParams, state: SimState,
         new_clock)
 
     # ------------------------------------------------- blocking events
-    blocked = comp_block | mem_rem | is_recv | is_bar | is_lock \
-        | send_block | is_cwait | is_csig | is_cbc | is_join \
+    # With miss chaining, memory misses BANK as chain element 0 instead of
+    # parking (the tile runs on with the line installed; resolve prices
+    # the chain) — so PEND_SH/EX/IFETCH parks never occur when P > 0 and
+    # the resolve pass compiles without the park machinery.
+    P = params.miss_chain
+    bank = (mem_rem | comp_block) if P > 0 \
+        else jnp.zeros_like(mem_rem)
+    blocked = ((comp_block | mem_rem) & ~bank) | is_recv | is_bar \
+        | is_lock | send_block | is_cwait | is_csig | is_cbc | is_join \
         | is_tstart
     kind = jnp.where(comp_block, PEND_IFETCH, PEND_NONE)
     kind = jnp.where(mem_rem & is_rd, PEND_SH_REQ, kind)
@@ -743,9 +956,26 @@ def _complex_slot(params: SimParams, state: SimState,
         jnp.where(mem_rem, at_extra, 0))
     pend_extra = jnp.where(blocked, extra, st.pend_extra)
 
+    # ---- bank the miss as chain element 0 (P > 0; the complex slot only
+    # runs on an empty chain, so slot 0 is free) and install the line
+    # locally — the same optimistic-install semantics as the window path.
+    if P > 0:
+        kind_ev = jnp.where(comp_block, PEND_IFETCH,
+                            jnp.where(is_wr, PEND_EX_REQ,
+                                      PEND_SH_REQ)).astype(jnp.int64)
+        mq_req0 = kind_ev | (is_at.astype(jnp.int64) << 3) | (line << 8)
+        mq_delta0 = issue          # element 0: absolute issue time
+        mq_extra0 = extra
+        mq_count = jnp.where(bank, 1, st.mq_count)
+        chain_rel = jnp.where(bank, 0, st.chain_rel)
+    else:
+        mq_count = st.mq_count
+        chain_rel = st.chain_rel
+
     # ------------------------------------------------- cache updates
     l1i = cachemod.touch(st.l1i, pI.set_idx, pI.way, is_comp & pI.hit & en,
                          _row_word(pI.row, pI.way), stamp)
+    mq_victim0 = jnp.zeros(T, dtype=jnp.int64)
     if shared_l2:
         l2 = st.l2
         d_word = _row_word(pD.row, pD.way)
@@ -756,6 +986,20 @@ def _complex_slot(params: SimParams, state: SimState,
                                   M, pD.state))
         l1d = cachemod.touch(st.l1d, pD.set_idx, pD.way, mem_l1,
                              d_word, stamp)
+        if P > 0:
+            # Banked-miss installs (L1-only under shared L2).
+            fDb = cachemod.fill(l1d, line,
+                                jnp.where(is_wr, M, S).astype(jnp.int32),
+                                bank & mem_rem, params.l1d.num_sets,
+                                params.l1d.replacement, stamp)
+            l1d = fDb.cache
+            fIb = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
+                                bank & comp_block, params.l1i.num_sets,
+                                params.l1i.replacement, stamp)
+            l1i = fIb.cache
+            vtag0 = jnp.where(comp_block, fIb.victim_tag, fDb.victim_tag)
+            vst0 = jnp.where(comp_block, fIb.victim_state, fDb.victim_state)
+            mq_victim0 = vst0.astype(jnp.int64) | (vtag0 << 3)
     else:
         fI = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
                            comp_l2path, params.l1i.num_sets,
@@ -774,6 +1018,32 @@ def _complex_slot(params: SimParams, state: SimState,
                            mem_l2, params.l1d.num_sets,
                            params.l1d.replacement, stamp)
         l1d = fD.cache
+        if P > 0:
+            # Banked-miss installs: L2 (victim recorded for resolve's
+            # directory notify) then L1D/L1I; the L2 victim's L1 copy
+            # drops now (inclusion).
+            f2b = cachemod.fill(l2, line,
+                                jnp.where(comp_block, S,
+                                          jnp.where(is_wr, M, S)).astype(
+                                              jnp.int32),
+                                bank, params.l2.num_sets,
+                                params.l2.replacement, stamp)
+            l2 = f2b.cache
+            mq_victim0 = f2b.victim_state.astype(jnp.int64) \
+                | (f2b.victim_tag << 3)
+            l1d = cachemod.invalidate_by_value(
+                l1d, f2b.victim_tag[:, None],
+                (bank & (f2b.victim_state != I))[:, None],
+                jnp.full((T, 1), I, dtype=jnp.int32))
+            fDb = cachemod.fill(l1d, line,
+                                jnp.where(is_wr, M, S).astype(jnp.int32),
+                                bank & mem_rem, params.l1d.num_sets,
+                                params.l1d.replacement, stamp)
+            l1d = fDb.cache
+            fIb = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
+                                bank & comp_block, params.l1i.num_sets,
+                                params.l1i.replacement, stamp)
+            l1i = fIb.cache
 
     # ------------------------------------------------------- counters
     # (all gated on the ROI flag: outside it nothing accumulates)
@@ -836,8 +1106,22 @@ def _complex_slot(params: SimParams, state: SimState,
         ch_sent=ch_sent,
         ch_time=ch_time,
         round_ctr=st.round_ctr + 1,
+        ctr_complex=st.ctr_complex + 1,
         counters=c,
     )
+    if P > 0:
+        st = st._replace(
+            mq_req=st.mq_req.at[0].set(
+                jnp.where(bank, mq_req0, st.mq_req[0])),
+            mq_victim=st.mq_victim.at[0].set(
+                jnp.where(bank, mq_victim0, st.mq_victim[0])),
+            mq_delta=st.mq_delta.at[0].set(
+                jnp.where(bank, mq_delta0, st.mq_delta[0])),
+            mq_extra=st.mq_extra.at[0].set(
+                jnp.where(bank, mq_extra0, st.mq_extra[0])),
+            mq_count=mq_count,
+            chain_rel=chain_rel,
+        )
     return st
 
 
@@ -845,24 +1129,41 @@ def local_advance(params: SimParams, state: SimState,
                   trace: TraceArrays) -> SimState:
     """Advance every non-blocked tile through events until the quantum
     boundary, stream end, or its first remote-blocking event.  Each loop
-    round is a block retirement (a [T, K] window of simple events) plus
-    one general slot; the loop exits as soon as no tile can retire
-    anything (all parked/done/at-boundary)."""
+    round is a block retirement (a [T, K] window of simple events +
+    banked misses) plus one general slot; the loop exits as soon as a
+    round retires nothing anywhere (every tile parked / done / at its
+    boundary / waiting on its miss chain)."""
 
-    N = trace.num_events
+    def progress(st):
+        return jnp.sum(st.cursor.astype(jnp.int64))
 
     def cond(carry):
-        i, st = carry
-        runnable = (~st.done) & (st.pend_kind == PEND_NONE) \
-            & (st.clock < st.boundary) & (st.cursor < N)
-        return (i < params.max_events_per_quantum) & runnable.any()
+        i, prev, st = carry
+        return (i < params.max_events_per_quantum) \
+            & ((i == 0) | (progress(st) > prev))
 
     def body(carry):
-        i, st = carry
+        i, _prev, st = carry
+        p0 = progress(st)
         if params.block_events > 0:
-            st = _block_retire(params, st, trace)
-        st = _complex_slot(params, st, trace)
-        return i + 1, st
+            # Inner window-only loop: the general slot costs as much as a
+            # whole window but usually has nothing to do — run windows
+            # until they stop retiring, THEN one general slot, repeat.
+            def wcond(c):
+                j, pv, s = c
+                return (j < params.max_events_per_quantum) \
+                    & ((j == 0) | (progress(s) > pv))
 
-    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+            def wbody(c):
+                j, pv, s = c
+                q0 = progress(s)
+                return j + 1, q0, _block_retire(params, s, trace)
+
+            _, _, st = jax.lax.while_loop(
+                wcond, wbody, (jnp.int32(0), jnp.int64(-1), st))
+        st = _complex_slot(params, st, trace)
+        return i + 1, p0, st
+
+    _, _, state = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.int64(-1), state))
     return state

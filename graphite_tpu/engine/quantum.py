@@ -111,10 +111,13 @@ def quantum_step(params: SimParams, state: SimState,
     progress (any event retired or unblocked — the cursor sum moves),
     capped at ``rounds_per_quantum``; quanta whose work drains in one
     sub-round (most of them) pay for one instead of the full cap."""
-    state = state._replace(boundary=next_boundary(params, state))
+    state = state._replace(boundary=next_boundary(params, state),
+                           ctr_quantum=state.ctr_quantum + 1)
 
     def progress(st):
-        return jnp.sum(st.cursor.astype(jnp.int64))
+        # cursor moves on any retire/bank/unblock; clock moves when a
+        # resolve pass drains a miss chain without retiring new events.
+        return jnp.sum(st.cursor.astype(jnp.int64)) + jnp.sum(st.clock)
 
     def cond(carry):
         i, prev, st = carry

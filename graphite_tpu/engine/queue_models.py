@@ -54,6 +54,145 @@ def _cumsum_doubling(x: jnp.ndarray) -> jnp.ndarray:
     return v
 
 
+class RingFcfsResult(NamedTuple):
+    start: jnp.ndarray       # [K] int64 service start times
+    end: jnp.ndarray         # [K] int64 completion times
+    delay: jnp.ndarray       # [K] int64 queueing delay
+    ring_start: jnp.ndarray  # [R, C] updated busy-interval ring
+    ring_end: jnp.ndarray
+    ring_ptr: jnp.ndarray    # [C] int32 next slot
+
+
+def _containing_end(res, t, ring_start, ring_end):
+    """[K] end of the busy interval containing time t on resource res
+    (t itself when no interval contains it)."""
+    rs = ring_start[:, res]                   # [R, K]
+    re = ring_end[:, res]
+    inside = (rs <= t[None, :]) & (t[None, :] < re)
+    return jnp.max(jnp.where(inside, re, t[None, :]), axis=0)
+
+
+def fcfs_ring(resource: jnp.ndarray, arrival: jnp.ndarray,
+              service: jnp.ndarray, valid: jnp.ndarray,
+              ring_start: jnp.ndarray, ring_end: jnp.ndarray,
+              ring_ptr: jnp.ndarray,
+              occ_res: jnp.ndarray = None, occ_arr: jnp.ndarray = None,
+              occ_svc: jnp.ndarray = None,
+              occ_valid: jnp.ndarray = None) -> RingFcfsResult:
+    """Exact-within-batch FCFS against a bounded busy-interval HISTORY —
+    the reference's history_list semantics (queue_model_history_list.cc):
+    a request arriving in an idle gap starts immediately (insertion into
+    the past), one arriving inside a busy interval waits for that
+    interval's end.  The single carried-horizon form over-delays any
+    request processed in a later batch than a farther-future one (phantom
+    convoys when batch partitioning mixes arrival times — the miss-chain
+    engine does); interval history bounds that error to genuine overlaps.
+
+    ring_*: [R, C] busy intervals per resource, unsorted ring (oldest
+    overwritten).  One merged interval is recorded per (resource, batch)
+    — within-batch gaps are conservatively marked busy.
+
+    occ_*: optional occupancy-only rows (writebacks): they insert busy
+    intervals but take no delay and return no times.
+    """
+    K = resource.shape[0]
+    R, C = ring_start.shape
+    res_eff = jnp.where(valid, resource, C).astype(jnp.int32)
+    res_g = jnp.minimum(res_eff, C - 1)
+    idx = jnp.arange(K, dtype=jnp.int32)
+    svc = jnp.where(valid, service, 0)
+
+    # Interval-resolved base: chase containing-interval ends a few times
+    # (adjacent intervals chain; 3 hops covers R=8 rings in practice).
+    base = arrival
+    for _ in range(3):
+        base = _containing_end(res_g, base, ring_start, ring_end)
+    base = jnp.where(valid, base, arrival)
+
+    # Exact within-batch serialization (same dense pairwise closed form
+    # as `fcfs`, with the per-row interval base).
+    same = valid[None, :] & valid[:, None] \
+        & (res_eff[None, :] == res_eff[:, None])
+    earlier = same & ((arrival[None, :] < arrival[:, None])
+                      | ((arrival[None, :] == arrival[:, None])
+                         & (idx[None, :] < idx[:, None])))
+    S_prev = jnp.sum(jnp.where(earlier, svc[None, :], 0), axis=1)
+    cand = base - S_prev
+    self_or_earlier = earlier | (jnp.eye(K, dtype=bool) & valid[:, None])
+    run = jnp.max(jnp.where(self_or_earlier, cand[None, :],
+                            jnp.int64(-(2**62))), axis=1)
+    start = run + S_prev
+    end = start + svc
+    delay = jnp.where(valid, start - arrival, 0)
+
+    # ---- record busy intervals: one merged [min start, max end] per
+    # (resource, batch) for the requests, one more for the occupancy rows.
+    BIG = jnp.int64(2**62)
+
+    def merged(res_m, valid_m, s_m, e_m):
+        lo = jnp.full((C,), BIG, jnp.int64).at[
+            jnp.where(valid_m, res_m, C)].min(s_m, mode="drop")
+        hi = jnp.zeros((C,), jnp.int64).at[
+            jnp.where(valid_m, res_m, C)].max(e_m, mode="drop")
+        return lo, hi, hi > 0
+
+    lo1, hi1, has1 = merged(res_eff, valid, start, end)
+    if occ_res is not None:
+        occ_end = occ_arr + occ_svc
+        lo2, hi2, has2 = merged(
+            jnp.where(occ_valid, occ_res, C).astype(jnp.int32),
+            occ_valid, occ_arr, occ_end)
+    else:
+        lo2 = hi2 = None
+        has2 = jnp.zeros((C,), dtype=bool)
+
+    cols = jnp.arange(C, dtype=jnp.int32)
+    slot1 = ring_ptr % R
+    ring_start = ring_start.at[
+        jnp.where(has1, slot1, R), cols].set(jnp.where(has1, lo1, 0),
+                                             mode="drop")
+    ring_end = ring_end.at[
+        jnp.where(has1, slot1, R), cols].set(jnp.where(has1, hi1, 0),
+                                             mode="drop")
+    ring_ptr = ring_ptr + has1.astype(jnp.int32)
+    if occ_res is not None:
+        slot2 = ring_ptr % R
+        ring_start = ring_start.at[
+            jnp.where(has2, slot2, R), cols].set(jnp.where(has2, lo2, 0),
+                                                 mode="drop")
+        ring_end = ring_end.at[
+            jnp.where(has2, slot2, R), cols].set(jnp.where(has2, hi2, 0),
+                                                 mode="drop")
+        ring_ptr = ring_ptr + has2.astype(jnp.int32)
+    return RingFcfsResult(start=jnp.where(valid, start, 0),
+                          end=jnp.where(valid, end, 0),
+                          delay=delay, ring_start=ring_start,
+                          ring_end=ring_end, ring_ptr=ring_ptr)
+
+
+def insert_busy(ring_start: jnp.ndarray, ring_end: jnp.ndarray,
+                ring_ptr: jnp.ndarray, res: jnp.ndarray, t0: jnp.ndarray,
+                svc, valid: jnp.ndarray):
+    """Occupancy-only insertion (writebacks off the critical path): one
+    merged busy interval per (resource, call).  Returns updated rings."""
+    R, C = ring_start.shape
+    BIG = jnp.int64(2**62)
+    svc = jnp.broadcast_to(jnp.asarray(svc, jnp.int64), t0.shape)
+    r_eff = jnp.where(valid, res, C).astype(jnp.int32)
+    lo = jnp.full((C,), BIG, jnp.int64).at[r_eff].min(t0, mode="drop")
+    hi = jnp.zeros((C,), jnp.int64).at[r_eff].max(t0 + svc, mode="drop")
+    has = hi > 0
+    cols = jnp.arange(C, dtype=jnp.int32)
+    slot = ring_ptr % R
+    ring_start = ring_start.at[
+        jnp.where(has, slot, R), cols].set(jnp.where(has, lo, 0),
+                                           mode="drop")
+    ring_end = ring_end.at[
+        jnp.where(has, slot, R), cols].set(jnp.where(has, hi, 0),
+                                           mode="drop")
+    return ring_start, ring_end, ring_ptr + has.astype(jnp.int32)
+
+
 def fcfs(resource: jnp.ndarray, arrival: jnp.ndarray, service: jnp.ndarray,
          valid: jnp.ndarray, free_at: jnp.ndarray) -> FcfsResult:
     """Exact FCFS service of a request batch over shared resources.

@@ -220,21 +220,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     nctl = params.dram.num_controllers
     ndsets = params.directory.num_sets
 
-    is_req = ((state.pend_kind == PEND_SH_REQ)
-              | (state.pend_kind == PEND_EX_REQ)
-              | (state.pend_kind == PEND_IFETCH))
-    line = state.pend_addr >> line_bits
-    is_ex = state.pend_kind == PEND_EX_REQ
-    is_if = state.pend_kind == PEND_IFETCH
-    home = home_of_line(params, line)
-    dset = dir_set_of_line(params, line)
-    issue = state.pend_issue
-    packed = _fcfs_keys(is_req, issue)
-    # Election-table slot: a full 64-bit mix before the modulo — plain
-    # ``line % H`` collapses power-of-two-strided per-tile buffers (which
-    # park in near-lockstep) onto a handful of slots, serializing requests
-    # that share nothing.
-    hidx = (dense.fmix64(line) % jnp.uint64(H)).astype(jnp.int32)
+    P = params.miss_chain
 
     # Per-tile clock periods.  (Shared L2: the "directory" access is the
     # slice's cache access, clocked by the L2 domain.)
@@ -252,29 +238,76 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     flits_req = noc.num_flits(CTRL_BYTES, params.net_memory.flit_width_bits)
     flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
                                params.net_memory.flit_width_bits)
-
-    # Conflict-round invariants, hoisted out of the loop: each pending
-    # request's home/line/set and everything derived only from them.
-    # (Per-home values are plain [T] gathers — the old dense [T, T]
-    # one-hot selects were O(T^2) per round.)
-    p_net_home = p_net[home]
-    p_dir_home = p_dir[home]
     dense_tables = T * H <= _DENSE_MAX_ELEMS
-    oh_hidx = _oh(hidx, H) if dense_tables else None
-    net_req = noc.unicast_ps(params.net_memory, rows, home, CTRL_BYTES,
-                             p_net, params.mesh_width)
-    reply_ps = noc.unicast_ps(params.net_memory, home, rows,
-                              params.line_size + CTRL_BYTES, p_net_home,
-                              params.mesh_width)
-    dir_ps = _lat(params.directory.access_cycles, p_dir_home)
-    fidx = (home * ndsets + dset).astype(jnp.int32)
+    slots_p = jnp.arange(max(P, 1), dtype=jnp.int32)[:, None]
+
+    def _parked(st):
+        k = st.pend_kind
+        return ((k == PEND_SH_REQ) | (k == PEND_EX_REQ)
+                | (k == PEND_IFETCH))
 
     def round_body(carry):
-        _i, state, resolved, line_floor = carry
-        unres = is_req & ~resolved
+        _i, state, ftbl_line, ftbl_t = carry
         # Requester-cache fill stamp for this conflict round (monotone
         # across local rounds and conflict rounds; see core.STAMP_STRIDE).
         rstamp = state.round_ctr * STAMP_STRIDE + STAMP_STRIDE - 1
+
+        # ---- active request per tile: the miss-chain head (P > 0 —
+        # memory misses always bank, never park) or the parked one-shot
+        # request (P == 0, the round-3 engine).  Chain heads advance as
+        # rounds serve them, so every request-derived quantity is
+        # computed per round.
+        if P > 0:
+            has_chain = state.mq_head < state.mq_count
+            head_oh = slots_p == state.mq_head[None, :]        # [P, T]
+
+            def hsel(arr):
+                return jnp.sum(jnp.where(head_oh, arr, 0), axis=0)
+
+            req = hsel(state.mq_req)
+            cvic = hsel(state.mq_victim)
+            cdelta = hsel(state.mq_delta)
+            # Element 0's delta is its absolute issue time; later elements
+            # chain off the previous element's continuation point.
+            issue = jnp.where(state.mq_head == 0, cdelta,
+                              state.chain_base + cdelta)
+            kind = (req & 7).astype(jnp.int32)
+            line = req >> 8
+            extra = hsel(state.mq_extra)
+            aux = ((req >> 3) & 1).astype(jnp.int32)
+            unres = has_chain
+        else:
+            has_chain = jnp.zeros(T, dtype=bool)
+            cvic = jnp.zeros(T, dtype=jnp.int64)
+            kind = state.pend_kind
+            line = state.pend_addr >> line_bits
+            issue = state.pend_issue
+            extra = state.pend_extra
+            aux = state.pend_aux
+            unres = _parked(state)
+        is_ex = unres & (kind == PEND_EX_REQ)
+        is_if = unres & (kind == PEND_IFETCH)
+        home = home_of_line(params, line)
+        dset = dir_set_of_line(params, line)
+        fidx = (home * ndsets + dset).astype(jnp.int32)
+        packed = _fcfs_keys(unres, issue)
+        # Election-table slot: a full 64-bit mix before the modulo — plain
+        # ``line % H`` collapses power-of-two-strided per-tile buffers
+        # (which park in near-lockstep) onto a handful of slots,
+        # serializing requests that share nothing.
+        hidx = (dense.fmix64(line) % jnp.uint64(H)).astype(jnp.int32)
+        oh_hidx = _oh(hidx, H) if dense_tables else None
+        p_net_home = p_net[home]
+        p_dir_home = p_dir[home]
+        net_req = noc.unicast_ps(params.net_memory, rows, home, CTRL_BYTES,
+                                 p_net, params.mesh_width)
+        reply_ps = noc.unicast_ps(params.net_memory, home, rows,
+                                  params.line_size + CTRL_BYTES, p_net_home,
+                                  params.mesh_width)
+        dir_ps = _lat(params.directory.access_cycles, p_dir_home)
+        # Per-line serialization floor from the carried (line, time) hash
+        # table (a stored-line check makes collisions inert).
+        line_floor = jnp.where(ftbl_line[hidx] == line, ftbl_t[hidx], 0)
 
         # ---- earliest-per-line election (the directory FSM serialization)
         if dense_tables:
@@ -718,20 +751,31 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             dsite = home
             to_dram_ps = from_dram_ps = jnp.int64(0)
         dram_arrival = t_dir + owner_ps + to_dram_ps
-        q = queue_models.fcfs(dsite, dram_arrival,
-                              jnp.full(T, dram_service_ps), need_read,
-                              state.dram_free_at)
-        dram_ready = q.start + dram_access_ps + dram_service_ps \
-            + from_dram_ps
-        # Writebacks (owner-leg flushes that reach DRAM, dirty victim
-        # evictions) occupy the controller off the critical path (write
-        # buffer): occupancy only.  MOSI owner forwards and shared-L2
+        # Writebacks (owner-leg flushes that reach DRAM) occupy the
+        # controller off the critical path (write buffer): occupancy-only
+        # rows in the interval queue.  MOSI owner forwards and shared-L2
         # transitions skip DRAM entirely (act.dram_write False); dirty
-        # victim evictions (M flushes, O slice lines) do land there.
+        # victim evictions insert their own intervals in the fills
+        # section below.
         dram_wb = (act.dram_write & win) | evict_m | evict_o
-        wb_occ = jnp.zeros(T, dtype=jnp.int64).at[
-            jnp.where(dram_wb, dsite, T)].add(dram_service_ps, mode="drop")
-        state = state._replace(dram_free_at=q.free_at + wb_occ)
+        if params.dram.queue_model_enabled:
+            q = queue_models.fcfs_ring(
+                dsite, dram_arrival, jnp.full(T, dram_service_ps),
+                need_read, state.dram_ring_start, state.dram_ring_end,
+                state.dram_ring_ptr,
+                occ_res=dsite, occ_arr=dram_arrival,
+                occ_svc=jnp.full(T, dram_service_ps), occ_valid=dram_wb)
+            state = state._replace(dram_ring_start=q.ring_start,
+                                   dram_ring_end=q.ring_end,
+                                   dram_ring_ptr=q.ring_ptr)
+            dram_start = q.start
+        else:
+            # [dram/queue_model] enabled=false: no queueing delay, no
+            # occupancy tracking (reference DramPerfModel without a
+            # queue model).
+            dram_start = jnp.where(need_read, dram_arrival, 0)
+        dram_ready = dram_start + dram_access_ps + dram_service_ps \
+            + from_dram_ps
 
         t_data = t_dir + owner_ps
         t_data = jnp.maximum(t_data, jnp.where(need_read, dram_ready, 0))
@@ -754,11 +798,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             _lat(params.l1d.access_cycles, p_l1))
         if params.shared_l2:
             # No private L2 to fill through on the requester side.
-            completion = reply_done + l1_fill_ps + state.pend_extra
+            completion = reply_done + l1_fill_ps + extra
         else:
             l2_fill_ps = _lat(params.l2.access_cycles, p_l2)
             completion = reply_done + l2_fill_ps + l1_fill_ps \
-                + state.pend_extra
+                + extra
 
         # ---- apply directory entry updates: single-way scatters.  The
         # way-slot election guarantees winners hold distinct
@@ -863,73 +907,102 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 inv_filter=state.inv_filter.at[tgt_rows, dslot].set(
                     (dlv_line_i + 1).astype(jnp.int32), mode="drop"))
 
-        # ---- requester-side fills (private L2 then L1, or L1-only under
-        # shared L2; L1D or L1I by request kind)
-        if params.shared_l2:
+        # ---- requester-side fills / victims.  P > 0: every winner is a
+        # chain element that installed its line at BANK time — only its
+        # recorded victim is processed here (directory notify + DRAM
+        # writeback occupancy).  P == 0: parked winners fill now, as in
+        # the round-3 engine.
+        win_chain = win if P > 0 else jnp.zeros_like(win)
+        win_park = jnp.zeros_like(win) if P > 0 else win
+        granted_e = win & ~is_ex & (act.new_state == E)
+        if P > 0:
+            vt1 = cvic >> 3
+            vs1 = (cvic & 7).astype(jnp.int32)
+            if params.protocol_kind == "sh_l2_mesi":
+                # A chain winner banked its read as S; an E grant raises
+                # the already-installed copy in place.
+                state = state._replace(l1d=cachemod.raise_line_state(
+                    state.l1d, rows.astype(jnp.int32), line,
+                    win_chain & granted_e & ~is_if, E,
+                    params.l1d.num_sets))
+        elif params.shared_l2:
             # MESI first-reader grant: fill the L1 line in E so a later
             # local store silently upgrades it (core.py mesi_local path).
-            granted_e = win & ~is_ex & (act.new_state == E)
             l1_state = jnp.where(is_ex, M,
                                  jnp.where(granted_e, E, S)).astype(
                                      jnp.int32)
-            fd = cachemod.fill(state.l1d, line, l1_state, win & ~is_if,
+            fd = cachemod.fill(state.l1d, line, l1_state,
+                               win_park & ~is_if,
                                params.l1d.num_sets, params.l1d.replacement,
                                rstamp)
             state = state._replace(l1d=fd.cache)
-            # L1 victims report back to their slice: dirty ones flush data
-            # into the slice (entry -> O), clean drops clear sharer bits.
-            # The dirty flush is a line-size WB data packet on the memory
-            # network (counted below via victim_dirty; off the critical
-            # path, so no latency/link-contention charge) — it lands in
-            # the slice, not DRAM.
-            victim_dirty = win & ~is_if & (fd.victim_state == M)
-            state = _sh_l1_evict_notify(
-                params, state, rows, fd.victim_tag, fd.victim_state,
-                win & ~is_if & (fd.victim_state != I))
             fi = cachemod.fill(state.l1i, line,
                                jnp.full(T, S, dtype=jnp.int32),
-                               win & is_if, params.l1i.num_sets,
+                               win_park & is_if, params.l1i.num_sets,
                                params.l1i.replacement, rstamp)
             state = state._replace(l1i=fi.cache)
-            state = _sh_l1_evict_notify(
-                params, state, rows, fi.victim_tag, fi.victim_state,
-                win & is_if & (fi.victim_state != I))
+            # i-fetch L1I victims notify separately below via vt_i.
+            vt1 = fd.victim_tag
+            vs1 = jnp.where(win_park & ~is_if, fd.victim_state, I)
+            vt_i, vs_i = fi.victim_tag, fi.victim_state
         else:
             f2 = cachemod.fill(state.l2, line,
                                jnp.where(is_ex, M, S).astype(jnp.int32),
-                               win, params.l2.num_sets,
+                               win_park, params.l2.num_sets,
                                params.l2.replacement, rstamp)
             state = state._replace(l2=f2.cache)
-            victim_dirty = win & ((f2.victim_state == M)
-                                  | (f2.victim_state == O))
-            victim_live = win & (f2.victim_state != I)
-            victim_home = dram_site_of_line(params, f2.victim_tag)
-            state = state._replace(
-                dram_free_at=state.dram_free_at.at[
-                    jnp.where(victim_dirty, victim_home, T)].add(
-                    dram_service_ps, mode="drop"))
+            vt1, vs1 = f2.victim_tag, f2.victim_state
             # An evicted-from-L2 line also leaves L1 (inclusive hierarchy,
             # reference l2_cache_cntlr invalidation of L1 on eviction).
             state = state._replace(l1d=cachemod.invalidate_by_value(
-                state.l1d, f2.victim_tag[:, None], victim_live[:, None],
+                state.l1d, f2.victim_tag[:, None],
+                (win_park & (f2.victim_state != I))[:, None],
                 jnp.full((T, 1), I, dtype=jnp.int32)))
-            # Notify the victim line's home directory (reference sends
-            # eviction writebacks that downgrade the entry; silently
-            # dropping them left stale owners/sharer bits that charge
-            # phantom coherence legs).  Off the requester's critical path.
-            state = _dir_evict_notify(params, state, rows, f2.victim_tag,
-                                      f2.victim_state, victim_live)
-
             fd = cachemod.fill(state.l1d, line,
                                jnp.where(is_ex, M, S).astype(jnp.int32),
-                               win & ~is_if, params.l1d.num_sets,
+                               win_park & ~is_if, params.l1d.num_sets,
                                params.l1d.replacement, rstamp)
             state = state._replace(l1d=fd.cache)
             fi = cachemod.fill(state.l1i, line,
                                jnp.full(T, S, dtype=jnp.int32),
-                               win & is_if, params.l1i.num_sets,
+                               win_park & is_if, params.l1i.num_sets,
                                params.l1i.replacement, rstamp)
             state = state._replace(l1i=fi.cache)
+
+        if params.shared_l2:
+            # L1 victims report back to their slice: dirty ones flush
+            # data into the slice (entry -> O), clean drops clear sharer
+            # bits.  The dirty flush is a line-size WB data packet on the
+            # memory network (counted below via victim_dirty; off the
+            # critical path, so no latency/link-contention charge) — it
+            # lands in the slice, not DRAM.
+            vlive1 = win & (vs1 != I)
+            victim_dirty = vlive1 & (vs1 == M)
+            state = _sh_l1_evict_notify(params, state, rows, vt1, vs1,
+                                        vlive1)
+            if P == 0:
+                state = _sh_l1_evict_notify(
+                    params, state, rows, vt_i, vs_i,
+                    win_park & is_if & (vs_i != I))
+        else:
+            victim_dirty = win & ((vs1 == M) | (vs1 == O))
+            victim_live = win & (vs1 != I)
+            victim_home = dram_site_of_line(params, vt1)
+            if params.dram.queue_model_enabled:
+                r3 = queue_models.insert_busy(
+                    state.dram_ring_start, state.dram_ring_end,
+                    state.dram_ring_ptr, victim_home, t_dir,
+                    dram_service_ps, victim_dirty)
+                state = state._replace(dram_ring_start=r3[0],
+                                       dram_ring_end=r3[1],
+                                       dram_ring_ptr=r3[2])
+            # Notify the victim line's home directory (reference sends
+            # eviction writebacks that downgrade the entry; silently
+            # dropping them left stale owners/sharer bits that charge
+            # phantom coherence legs).  Off the requester's critical path.
+            # (Chain victims' L1 copies already dropped at bank time.)
+            state = _dir_evict_notify(params, state, rows, vt1, vs1,
+                                      victim_live)
 
         # ---- counters (all home-binned tallies via dense one-hot sums)
         kcnt_inv = jnp.sum(inv_bool, axis=1).astype(jnp.int64)  # [K]
@@ -1015,9 +1088,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # i-fetches always wait in full.  (Reference:
         # iocoom_core_model.cc:78- load queue / store buffer.)
         if params.core.model == "iocoom":
-            is_atomic = state.pend_aux != 0
-            is_load = win & (state.pend_kind == PEND_SH_REQ) & ~is_atomic
-            is_store = win & (state.pend_kind == PEND_EX_REQ) & ~is_atomic
+            is_atomic = aux != 0
+            is_load = win & (kind == PEND_SH_REQ) & ~is_atomic
+            is_store = win & (kind == PEND_EX_REQ) & ~is_atomic
             LQE = state.lq_ready.shape[0]
             SQE = state.sq_ready.shape[0]
             lq_oh = dense.onehot(state.lq_next % LQE, LQE).T \
@@ -1046,52 +1119,86 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         else:
             unpark = completion
 
-        state = _unblock(state, win, unpark, sync=False)
+        # Parked winners unblock (cursor advance + stall accounting).
+        import os
+        if os.environ.get("GTPU_DEBUG_RESOLVE"):
+            jax.debug.print(
+                "RB t0 win={w} line={l} issue={i} arrive={a} tdir={td} "
+                "tdata={tv} unpark={u}",
+                w=win[0], l=line[0], i=issue[0], a=arrive[0],
+                td=t_dir[0], tv=t_data[0], u=unpark[0])
+        # Parked winners unblock (cursor advance + stall accounting;
+        # P > 0 has no memory parks — the complex slot banks instead).
+        if P == 0:
+            state = _unblock(state, win_park, unpark, sync=False)
+        # Chain winners advance their chain: the continuation point
+        # becomes the base for the next element's issue; a fully drained
+        # chain restores the absolute clock (base + accumulated local
+        # time) and frees the bank for the next window.
+        if P > 0:
+            c4 = state.counters
+            new_head = state.mq_head + win_chain.astype(jnp.int32)
+            drained = win_chain & (new_head >= state.mq_count)
+            state = state._replace(
+                mq_head=jnp.where(drained, 0, new_head),
+                mq_count=jnp.where(drained, 0, state.mq_count),
+                chain_base=jnp.where(win_chain, unpark, state.chain_base),
+                clock=jnp.where(drained, unpark + state.chain_rel,
+                                state.clock),
+                chain_rel=jnp.where(drained, 0, state.chain_rel),
+                counters=c4._replace(
+                    mem_stall_ps=c4.mem_stall_ps
+                    + jnp.where(win_chain, unpark - issue, 0)))
 
         # ---- serialization floor for still-pending same-line requests:
-        # per-line winner's data-availability time, via the same hash table
-        # (a stored-line check makes collisions inert).
+        # per-line winner's data-availability time, into the carried
+        # (line, time) hash table (collisions inert via the line check).
         t_free = t_data
         if dense_tables:
             win_oh = oh_hidx & win[:, None]
-            ftbl_line = jnp.max(
+            new_line = jnp.max(
                 jnp.where(win_oh, line[:, None], jnp.int64(-1)), axis=0)
-            ftbl_t = jnp.max(jnp.where(win_oh, t_free[:, None], 0), axis=0)
-            line_floor = jnp.maximum(
-                line_floor,
-                jnp.where(_sel(oh_hidx, ftbl_line) == line,
-                          _sel(oh_hidx, ftbl_t), 0))
+            new_t = jnp.max(jnp.where(win_oh, t_free[:, None], 0), axis=0)
+            wrote = win_oh.any(axis=0)
+            ftbl_line = jnp.where(wrote, new_line, ftbl_line)
+            ftbl_t = jnp.where(wrote, new_t, ftbl_t)
         else:
-            ftbl_line = jnp.full((H,), -1, dtype=jnp.int64).at[
+            ftbl_line = ftbl_line.at[
                 jnp.where(win, hidx, H)].set(line, mode="drop")
-            ftbl_t = jnp.zeros((H,), dtype=jnp.int64).at[
-                jnp.where(win, hidx, H)].max(t_free, mode="drop")
-            line_floor = jnp.maximum(
-                line_floor,
-                jnp.where(ftbl_line[hidx] == line, ftbl_t[hidx], 0))
-        resolved = resolved | win
-        state = state._replace(round_ctr=state.round_ctr + 1)
-        return _i + 1, state, resolved, line_floor
+            ftbl_t = ftbl_t.at[
+                jnp.where(win, hidx, H)].set(t_free, mode="drop")
+        state = state._replace(round_ctr=state.round_ctr + 1,
+                               ctr_conflict=state.ctr_conflict + 1)
+        return _i + 1, state, ftbl_line, ftbl_t
 
     # Early-exit conflict rounds: a round only runs while unresolved
-    # requests remain (identical results to the fixed-count loop — rounds
-    # with no unresolved requests elect no winners and change nothing).
-    def round_cond(carry):
-        i, _state, resolved, _floor = carry
-        return (i < params.directory_conflict_rounds) \
-            & (is_req & ~resolved).any()
+    # requests remain (parked requests clear their pend kind on service;
+    # chain heads advance to their counts).
+    def _more(st):
+        if P > 0:
+            return (st.mq_head < st.mq_count).any()
+        return _parked(st).any()
 
-    carry = (jnp.int32(0), state, jnp.zeros(T, dtype=bool),
-             jnp.zeros(T, dtype=jnp.int64))
-    _, state, resolved, _ = jax.lax.while_loop(round_cond, round_body, carry)
-    # Saturation visibility (VERDICT weak #5): requests still parked after a
-    # full resolve pass slipped past the conflict-round budget and will be
-    # retried next sub-round.
-    saturated = is_req & ~resolved
+    cap = params.max_resolve_rounds if P > 0 \
+        else params.directory_conflict_rounds
+
+    def round_cond(carry):
+        i, st, _fl, _ft = carry
+        return (i < cap) & _more(st)
+
+    state = state._replace(ctr_resolve=state.ctr_resolve + 1)
+    carry = (jnp.int32(0), state,
+             jnp.full((H,), -1, dtype=jnp.int64),
+             jnp.zeros((H,), dtype=jnp.int64))
+    _, state, _, _ = jax.lax.while_loop(round_cond, round_body, carry)
+    # Saturation visibility (VERDICT weak #5): requests still pending after
+    # a full resolve pass slipped past the round cap and will be retried
+    # next sub-round (binned at the requester tile).
+    saturated = (state.mq_head < state.mq_count) if P > 0 \
+        else _parked(state)
     c = state.counters
     state = state._replace(counters=c._replace(
-        dir_deferrals=c.dir_deferrals.at[home].add(
-            saturated.astype(jnp.int64))))
+        dir_deferrals=c.dir_deferrals + saturated.astype(jnp.int64)))
     return state
 
 
@@ -1555,10 +1662,14 @@ def resolve(params: SimParams, state: SimState) -> SimState:
     TPU, so per-kind gating (round 2's shape) paid ~7 state copies per
     sub-round; the per-kind resolvers are no-ops on empty masks anyway.
     """
+    if params.miss_chain > 0:
+        any_mem = (state.mq_count > 0).any()
+    else:
+        any_mem = ((state.pend_kind == PEND_SH_REQ)
+                   | (state.pend_kind == PEND_EX_REQ)
+                   | (state.pend_kind == PEND_IFETCH)).any()
     state = jax.lax.cond(
-        ((state.pend_kind == PEND_SH_REQ) | (state.pend_kind == PEND_EX_REQ)
-         | (state.pend_kind == PEND_IFETCH)).any(),
-        lambda s: resolve_memory(params, s), lambda s: s, state)
+        any_mem, lambda s: resolve_memory(params, s), lambda s: s, state)
 
     def sync_pass(s: SimState) -> SimState:
         if s.has_capi:

@@ -265,6 +265,30 @@ class SimState(NamedTuple):
     #   per-(plane, way) dynamic-update-slice loops (~30 ms/round at
     #   1024 tiles).  See dir_sharers_view for the unpacked view.
 
+    # -- banked miss chains (tpu/miss_chain > 0; engine/core.py window).
+    # The block window executes past L2 misses: the line is installed
+    # optimistically at bank time and the request is banked here; resolve
+    # prices whole chains FCFS (element k+1's issue = element k's
+    # completion + its recorded local delta).  Packed fields:
+    #   mq_req    int64: kind (PEND_SH/EX/IFETCH) bits 0-2 | atomic bit 3
+    #             | line << 8
+    #   mq_victim int64: local-install victim state bits 0-2 | tag << 3
+    #             (private: the L2 victim; shared-L2: the L1 victim)
+    #   mq_delta  int64 ps: element 0 — ABSOLUTE issue time; element k>0 —
+    #             issue relative to element k-1's continuation point
+    #   mq_extra  int64 ps: local cost folded into the completion
+    # chain_rel is the local time accumulated since the last banked
+    # element's (not yet known) continuation point; chain_base is the
+    # continuation time of the last SERVED element (mq_head of them).
+    mq_req: jnp.ndarray        # [P, T] int64
+    mq_victim: jnp.ndarray     # [P, T] int64
+    mq_delta: jnp.ndarray      # [P, T] int64
+    mq_extra: jnp.ndarray      # [P, T] int64
+    mq_count: jnp.ndarray      # [T] int32 banked elements
+    mq_head: jnp.ndarray       # [T] int32 served elements (< count: mid-chain)
+    chain_base: jnp.ndarray    # [T] int64 ps
+    chain_rel: jnp.ndarray     # [T] int64 ps
+
     # -- iocoom load/store queues (reference: iocoom_core_model.cc:78-;
     # completion-time rings — a load/store miss parks the tile only until
     # the resolve phase PRICES it; under iocoom the core then continues
@@ -276,8 +300,13 @@ class SimState(NamedTuple):
     lq_next: jnp.ndarray       # [T] int32 ring cursor
     sq_next: jnp.ndarray       # [T] int32
 
-    # -- memory controllers (reference: dram_cntlr.h + dram_perf_model.h)
-    dram_free_at: jnp.ndarray  # [T] int64 — FCFS queue-model horizon
+    # -- memory controllers (reference: dram_cntlr.h + dram_perf_model.h;
+    # queueing per queue_model_history_list.cc — a bounded ring of busy
+    # intervals per controller, so requests arriving in idle gaps insert
+    # into the past instead of queueing behind a farther-future horizon)
+    dram_ring_start: jnp.ndarray  # [R, T] int64 busy-interval starts
+    dram_ring_end: jnp.ndarray    # [R, T] int64 busy-interval ends
+    dram_ring_ptr: jnp.ndarray    # [T] int32 next ring slot
 
     # -- mesh link horizons (emesh_hop_by_hop contention; reference:
     # per-link queue models in network_model_emesh_hop_by_hop.cc)
@@ -327,6 +356,14 @@ class SimState(NamedTuple):
     # -- engine round counter (stamp source for the timestamp-LRU caches;
     # bumped once per local round and per resolve conflict round)
     round_ctr: jnp.ndarray     # [] int32
+    # Phase execution counters (device-work attribution for bench.py's
+    # per-phase breakdown): window retirements, complex slots, resolve
+    # conflict rounds, resolve calls, quantum steps.
+    ctr_window: jnp.ndarray    # [] int64
+    ctr_complex: jnp.ndarray   # [] int64
+    ctr_conflict: jnp.ndarray  # [] int64
+    ctr_resolve: jnp.ndarray   # [] int64
+    ctr_quantum: jnp.ndarray   # [] int64
 
     # -- miss-type classification filters ([cache]/track_miss_types,
     # reference cache.h:45-49 cold/capacity/sharing counters).  Per-tile
@@ -386,6 +423,7 @@ def _dummy_cache(num_tiles: int) -> cachemod.CacheArrays:
 
 
 NUM_CONDS = 64      # cond-var id space (like max_mutexes; ids clip)
+DRAM_RING_SLOTS = 8  # busy-interval history per memory controller
 MISS_FILTER_SLOTS = 1 << 14   # per-tile miss-type filter entries (2x the
 #                               T1 L2's 8192 lines: "seen" memory must
 #                               outlast the cache for capacity vs cold)
@@ -432,13 +470,23 @@ def make_state(params: SimParams,
         dir_word=jnp.zeros(d_shape, dtype=jnp.int64),
         dir_sharers=jnp.zeros((W * d_shape[0], d_shape[1]),
                               dtype=jnp.uint64),
+        mq_req=jnp.zeros((params.miss_chain, T), dtype=jnp.int64),
+        mq_victim=jnp.zeros((params.miss_chain, T), dtype=jnp.int64),
+        mq_delta=jnp.zeros((params.miss_chain, T), dtype=jnp.int64),
+        mq_extra=jnp.zeros((params.miss_chain, T), dtype=jnp.int64),
+        mq_count=jnp.zeros(T, dtype=jnp.int32),
+        mq_head=jnp.zeros(T, dtype=jnp.int32),
+        chain_base=jnp.zeros(T, dtype=jnp.int64),
+        chain_rel=jnp.zeros(T, dtype=jnp.int64),
         lq_ready=jnp.zeros((params.core.load_queue_entries, T),
                            dtype=jnp.int64),
         sq_ready=jnp.zeros((params.core.store_queue_entries, T),
                            dtype=jnp.int64),
         lq_next=jnp.zeros(T, dtype=jnp.int32),
         sq_next=jnp.zeros(T, dtype=jnp.int32),
-        dram_free_at=jnp.zeros(T, dtype=jnp.int64),
+        dram_ring_start=jnp.zeros((DRAM_RING_SLOTS, T), dtype=jnp.int64),
+        dram_ring_end=jnp.zeros((DRAM_RING_SLOTS, T), dtype=jnp.int64),
+        dram_ring_ptr=jnp.zeros(T, dtype=jnp.int32),
         link_free_mem=noc_flight.make_link_free(T),
         lock_holder=jnp.zeros(max_mutexes, dtype=jnp.int32),
         lock_free_at=jnp.zeros(max_mutexes, dtype=jnp.int64),
@@ -459,6 +507,11 @@ def make_state(params: SimParams,
         ch_time=jnp.zeros((channel_depth, T, T) if has_capi else (0, 0, 0),
                           dtype=jnp.int64),
         round_ctr=jnp.int32(0),
+        ctr_window=jnp.int64(0),
+        ctr_complex=jnp.int64(0),
+        ctr_conflict=jnp.int64(0),
+        ctr_resolve=jnp.int64(0),
+        ctr_quantum=jnp.int64(0),
         seen_filter=jnp.zeros(
             (T, MISS_FILTER_SLOTS) if params.track_miss_types else (1, 1),
             dtype=jnp.int32),

@@ -406,6 +406,17 @@ class SimParams:
     # budget defer to the next round (counted in dir_deferrals).  Bounds the
     # per-round invalidation scatter at [budget, T] instead of [T, T].
     max_inv_fanout_per_round: int
+    # Miss-chain banking depth (the round-4 perf design): the block window
+    # keeps executing past L2 misses, installing the line optimistically
+    # and banking up to this many pending requests per tile; one resolve
+    # pass then prices each tile's whole chain (element k+1's issue is
+    # element k's completion plus the recorded local delta), so a tile
+    # costs ~1 device round per CHAIN instead of one per miss.  0 restores
+    # the round-3 one-parked-request engine (the equivalence oracle).
+    miss_chain: int
+    # Upper bound on conflict rounds per resolve pass (chains + same-line
+    # serialization); leftovers carry to the next pass via mq_head.
+    max_resolve_rounds: int
     channel_depth: int
     # Captured-trace replay: a recorded COND_WAIT provably consumed SOME
     # signal in the native run, but simulated retiming can invert the
@@ -590,6 +601,11 @@ class SimParams:
             max_inv_fanout_per_round=_positive(cfg.get_int(
                 "tpu/max_inv_fanout_per_round", 8),
                 "tpu/max_inv_fanout_per_round"),
+            miss_chain=_nonneg(cfg.get_int("tpu/miss_chain", 12),
+                               "tpu/miss_chain"),
+            max_resolve_rounds=_positive(
+                cfg.get_int("tpu/max_resolve_rounds", 64),
+                "tpu/max_resolve_rounds"),
             channel_depth=cfg.get_int("tpu/channel_depth", 16),
             cond_replay=cfg.get_bool("tpu/cond_replay", False),
         )

@@ -32,7 +32,7 @@ def test_sharded_matches_single_device():
     ta = shard_pytree(tarrays, mesh, tiles)
     out = megastep(params, st, ta)
 
-    for name in ("clock", "cursor", "pend_kind", "dram_free_at"):
+    for name in ("clock", "cursor", "pend_kind", "dram_ring_end"):
         assert np.array_equal(np.asarray(getattr(ref, name)),
                               np.asarray(getattr(out, name))), name
     for f in ref.counters._fields:
