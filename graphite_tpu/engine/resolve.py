@@ -192,6 +192,418 @@ def _unblock(state: SimState, mask, completion, sync: bool) -> SimState:
 
 # ===================================================================== memory
 
+def _cumsum_p(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive prefix sum along axis 0 via doubling (log2 P shifted
+    adds; XLA:TPU lowers int64 cumsum to reduce-window — see
+    queue_models._cumsum_doubling)."""
+    v = x
+    d = 1
+    Pn = x.shape[0]
+    while d < Pn:
+        pad = jnp.zeros((d,) + x.shape[1:], x.dtype)
+        v = v + jnp.concatenate([pad, v[:-d]], axis=0)
+        d *= 2
+    return v
+
+
+def chain_fast_pass(params: SimParams, state: SimState) -> SimState:
+    """Price and apply every NON-CONFLICTING banked chain element in ONE
+    [P, T] pass — the round-4 throughput core.
+
+    The conflict-round loop serves one chain element per tile per round,
+    so its total round count equals the longest miss chain — ~one device
+    round per miss, the round-3 engine's wall-clock floor.  But almost
+    all requests in real traces are independent: distinct lines, trivial
+    directory transitions (SH on I/S, EX on I or on an entry the
+    requester already owns), no invalidation fan-out, no owner legs.
+    This pass detects exactly those, prices whole chains with two prefix
+    sums (zero-load round trips + a one-iteration DRAM-queue correction),
+    and applies all directory/counter effects with a handful of batched
+    scatters.  Each tile's chain is served up to its first non-fast
+    element (chain order is a strict prefix); everything after stays
+    banked for the exact conflict-round loop that follows.
+
+    Approximations vs the round loop (all within the lax model's slack,
+    see tests/test_chain_equivalence.py): DRAM queue delays are computed
+    against pre-correction arrival times (one fixpoint iteration), and
+    same-(home,dset) allocation ranks order by chain position rather
+    than exact issue time.  Simple in-order cores only (iocoom chains
+    thread their LQ/SQ rings through the round loop).
+    """
+    P = params.miss_chain
+    T = params.num_tiles
+    A = params.directory.associativity
+    W = state.dir_sharers.shape[0] // A
+    ndsets = params.directory.num_sets
+    R = P * T
+    H2 = 1 << (4 * R - 1).bit_length()          # line-group table size
+    rows_t = jnp.arange(T)
+    slots = jnp.arange(P, dtype=jnp.int32)[:, None]            # [P, 1]
+    tile_of = jnp.broadcast_to(rows_t[None, :], (P, T)).astype(jnp.int32)
+    shared_l2 = params.shared_l2
+    full_map = params.directory.directory_type == "full_map"
+
+    head = state.mq_head
+    valid = (slots >= head[None, :]) & (slots < state.mq_count[None, :])
+    req = state.mq_req
+    line = jnp.where(valid, req >> 8, -1 - slots.astype(jnp.int64))
+    kind = (req & 7).astype(jnp.int32)
+    is_ex = valid & (kind == PEND_EX_REQ)
+    is_if = valid & (kind == PEND_IFETCH)
+    home = home_of_line(params, jnp.maximum(line, 0))
+    dset = dir_set_of_line(params, jnp.maximum(line, 0))
+    fidx = (home * ndsets + dset).astype(jnp.int32)
+    line32 = line.astype(jnp.int32)
+
+    # ---- directory probe: one [A, P, T] gather
+    drow = state.dir_word[:, fidx]
+    dstate = dword_state(drow)
+    match = (dword_tag(drow) == line32[None]) & (dstate != I)
+    hit = match.any(axis=0) & valid
+    hway = jnp.argmax(match, axis=0).astype(jnp.int32)
+    invalid_w = dstate == I
+    has_inv_w = invalid_w.any(axis=0)
+    first_inv = jnp.argmax(invalid_w, axis=0).astype(jnp.int32)
+    lru_way = jnp.argmin(dword_stamp(drow), axis=0).astype(jnp.int32)
+
+    # ---- line groups (combining + conflict detection), hash tables over
+    # all R elements; lmin/lmax verify make hash collisions conservative.
+    hsl = (dense.fmix64(line) % jnp.uint64(H2)).astype(jnp.int32)
+    hsl_v = jnp.where(valid, hsl, H2)
+    flat_r = (slots * T + rows_t[None, :]).astype(jnp.int32)   # [P, T]
+    cnt_t = jnp.zeros((H2,), jnp.int32).at[hsl_v].add(1, mode="drop")
+    ex_t = jnp.zeros((H2,), bool).at[
+        jnp.where(is_ex, hsl, H2)].set(True, mode="drop")
+    lmin_t = jnp.full((H2,), 2**62, jnp.int64).at[hsl_v].min(
+        line, mode="drop")
+    lmax_t = jnp.full((H2,), -2**62, jnp.int64).at[hsl_v].max(
+        line, mode="drop")
+    rep_t = jnp.full((H2,), R, jnp.int32).at[hsl_v].min(
+        flat_r, mode="drop")
+    multi = valid & (cnt_t[hsl] > 1)
+    mixed = valid & (lmin_t[hsl] != lmax_t[hsl])
+    is_rep = valid & (rep_t[hsl] == flat_r)
+
+    # ---- victim way for allocating reps: ranked within (home, dset)
+    # groups by chain position (invalid ways first, then stamp-LRU, ways
+    # held by hits excluded); rank overflow defers to the round loop.
+    fh = (dense.fmix64(fidx.astype(jnp.int64))
+          % jnp.uint64(H2)).astype(jnp.int32)
+    used_t = jnp.zeros((H2, A), bool).at[
+        jnp.where(hit, fh, H2), hway].set(True, mode="drop")
+    hway_used = used_t[fh]                                     # [P, T, A]
+    alloc_cand = valid & ~hit & is_rep
+    grank = _grouped_rank(fidx.reshape(R), flat_r.reshape(R).astype(
+        jnp.int64), alloc_cand.reshape(R)).reshape(P, T)
+    NEVER = jnp.int32(2**31 - 1)
+    dstampw = dword_stamp(drow).transpose(1, 2, 0)             # [P, T, A]
+    vkey = jnp.where(hway_used, NEVER,
+                     jnp.where(invalid_w.transpose(1, 2, 0), -1, dstampw))
+    eligible = ~hway_used
+    arA = jnp.arange(A, dtype=jnp.int32)
+    pos = jnp.sum(
+        eligible[..., None, :]
+        & ((vkey[..., None, :] < vkey[..., :, None])
+           | ((vkey[..., None, :] == vkey[..., :, None])
+              & (arA[None, None, None, :] < arA[None, None, :, None]))),
+        axis=3).astype(jnp.int32)                              # [P, T, A]
+    n_elig = jnp.sum(eligible, axis=2).astype(jnp.int32)
+    miss_way = jnp.argmax(eligible & (pos == grank[..., None]),
+                          axis=2).astype(jnp.int32)
+    can_alloc = alloc_cand & (grank < n_elig)
+    way = jnp.where(hit, hway, miss_way)
+
+    # ---- transition (flattened [R] view — elementwise + [R, W] bitmaps)
+    way_word = jnp.take_along_axis(
+        drow, way[None], axis=0)[0]                            # [P, T]
+    way_state = dword_state(way_word)
+    entry_state = jnp.where(hit, way_state, I)
+    entry_owner = jnp.where(hit, dword_owner(way_word), -1)
+    shar_rows = state.dir_sharers[:, fidx].reshape(W, A, P, T)
+    entry_sharers = jnp.where(
+        hit[None], jnp.take_along_axis(
+            shar_rows, way[None, None], axis=1)[:, 0], jnp.uint64(0))
+    entry_sharers_r = entry_sharers.reshape(W, R).T            # [R, W]
+    act = dirmod.transition(
+        params.protocol_kind, is_ex.reshape(R), tile_of.reshape(R),
+        entry_state.reshape(R), entry_owner.reshape(R), entry_sharers_r,
+        W, is_ifetch=is_if.reshape(R))
+    owner_leg = act.owner_leg.reshape(P, T)
+    has_invs = (act.inv_targets != jnp.uint64(0)).any(
+        axis=1).reshape(P, T)
+    need_read_e = act.dram_read.reshape(P, T)
+
+    # ---- directory-victim entry of allocating reps: fast only when it
+    # needs no traffic (I, or S/O with an empty sharer bitmap).
+    vic_e_state = jnp.where(can_alloc, way_state, I)
+    vic_e_sharers = jnp.where(
+        can_alloc[None], jnp.take_along_axis(
+            shar_rows, way[None, None], axis=1)[:, 0], jnp.uint64(0))
+    vic_e_live_traffic = (vic_e_state == M) | (vic_e_state == E) \
+        | (vic_e_sharers != jnp.uint64(0)).any(axis=0)
+    evicting = can_alloc & (vic_e_state != I)
+
+    # ---- combining (all-SH line groups against I/S entries, full_map)
+    if full_map:
+        sh_entry_ok = (entry_state == I) | (entry_state == S)
+        if shared_l2:
+            sh_entry_ok = sh_entry_ok & (entry_state != I)
+        combine = multi & ~mixed & ~ex_t[hsl] & ~is_ex & sh_entry_ok
+    else:
+        combine = jnp.zeros_like(multi)
+    member = combine & ~is_rep
+    # Members adopt their rep's way (written once by the rep).
+    way_rep_t = jnp.zeros((H2,), jnp.int32).at[
+        jnp.where(is_rep, hsl, H2)].set(way, mode="drop")
+    way = jnp.where(member, way_rep_t[hsl], way)
+
+    # ---- FAST classification
+    fast = valid & ~owner_leg & ~has_invs \
+        & (hit | member | (can_alloc & ~vic_e_live_traffic)) \
+        & (~multi | combine) & ~mixed
+    # A member is only fast if its rep is (checked after the prefix
+    # cutoff below, iterated to a fixpoint).
+
+    # ---- prefix cutoff: serve each chain up to its first non-fast
+    # element; a combining member whose rep got cut goes slow too.
+    first_slow = jnp.min(jnp.where(valid & ~fast, slots, P),
+                         axis=0).astype(jnp.int32)             # [T]
+    for _ in range(3):
+        served = valid & (slots < first_slow[None, :])
+        rep_srv_t = jnp.zeros((H2,), bool).at[
+            jnp.where(is_rep & served, hsl, H2)].set(True, mode="drop")
+        bad_member = member & served & ~rep_srv_t[hsl]
+        first_slow = jnp.minimum(first_slow, jnp.min(
+            jnp.where(bad_member, slots, P), axis=0).astype(jnp.int32))
+    served = valid & (slots < first_slow[None, :])
+    rep_srv = is_rep & served
+    n_new = jnp.maximum(first_slow - head, 0)
+
+    # ---- timing: zero-load chain prefix + one-pass DRAM correction
+    p_net = _period(state, DVFSModule.NETWORK_MEMORY)
+    p_dir = _period(state, DVFSModule.L2_CACHE if shared_l2
+                    else DVFSModule.DIRECTORY)
+    p_l2 = _period(state, DVFSModule.L2_CACHE)
+    p_l1d = _period(state, DVFSModule.L1_DCACHE)
+    p_l1i = _period(state, DVFSModule.L1_ICACHE)
+    p_net_home = p_net[home]
+    net_req = noc.unicast_ps(params.net_memory, tile_of, home, CTRL_BYTES,
+                             p_net[None, :], params.mesh_width)
+    reply_ps = noc.unicast_ps(params.net_memory, home, tile_of,
+                              params.line_size + CTRL_BYTES, p_net_home,
+                              params.mesh_width)
+    dir_ps = _lat(params.directory.access_cycles, p_dir[home])
+    dram_access_ps = jnp.int64(params.dram.latency_ps)
+    dram_service_ps = jnp.int64(
+        params.dram.processing_ps_per_line(params.line_size))
+    l1_fill_ps = jnp.where(
+        is_if, _lat(params.l1i.access_cycles, p_l1i[None, :]),
+        _lat(params.l1d.access_cycles, p_l1d[None, :]))
+    if shared_l2:
+        dsite = dram_site_of_line(params, jnp.maximum(line, 0))
+        local_ctl = home == dsite
+        to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
+            params.net_memory, home, dsite, CTRL_BYTES, p_net_home,
+            params.mesh_width))
+        from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
+            params.net_memory, dsite, home,
+            params.line_size + CTRL_BYTES, p_net[dsite],
+            params.mesh_width))
+        fill_ps = l1_fill_ps
+    else:
+        dsite = home
+        to_dram_ps = from_dram_ps = jnp.int64(0)
+        fill_ps = _lat(params.l2.access_cycles, p_l2[None, :]) + l1_fill_ps
+    need_read = need_read_e & served
+    dram_leg = jnp.where(need_read_e,
+                         to_dram_ps + dram_access_ps + dram_service_ps
+                         + from_dram_ps, 0)
+    rt0 = net_req + dir_ps + dram_leg + reply_ps + fill_ps \
+        + state.mq_extra
+    # completion_k = base0 + sum_{head<=j<=k} (delta_j + rt0_j)
+    step = jnp.where(valid, state.mq_delta + rt0, 0)
+    base0 = jnp.where(head == 0, 0, state.chain_base)
+    comp0 = base0[None, :] + _cumsum_p(step)                   # [P, T]
+    issue0 = comp0 - rt0
+
+    # DRAM-queue correction against pre-correction arrivals; each tile's
+    # later elements inherit its earlier elements' delays (prefix).
+    if params.dram.queue_model_enabled:
+        arr = issue0 + net_req + dir_ps + to_dram_ps
+        q = queue_models.fcfs_ring(
+            dsite.reshape(R), arr.reshape(R),
+            jnp.full((R,), dram_service_ps), need_read.reshape(R),
+            state.dram_ring_start, state.dram_ring_end,
+            state.dram_ring_ptr)
+        delay = q.delay.reshape(P, T)
+        state = state._replace(dram_ring_start=q.ring_start,
+                               dram_ring_end=q.ring_end,
+                               dram_ring_ptr=q.ring_ptr)
+    else:
+        delay = jnp.zeros((P, T), jnp.int64)
+    cum_delay = _cumsum_p(jnp.where(served, delay, 0))
+    completion = comp0 + cum_delay
+    issue = issue0 + cum_delay - delay
+
+    # ---- apply: directory entries (reps + non-combined winners write
+    # their slot; distinct (home, dset, way) by construction)
+    writer = served & (is_rep | ~combine)
+    fidx_w = jnp.where(writer, fidx, jnp.int32(2**30))
+    state = state._replace(dir_word=state.dir_word.at[way, fidx_w].set(
+        dword_pack(jnp.maximum(line, 0), state.round_ctr,
+                   act.new_state.reshape(P, T),
+                   act.new_owner.reshape(P, T)), mode="drop"))
+    # Sharer bitmaps: writers land (new - old) per plane; combining
+    # members add their own bit (guarded: bit not already set).
+    new_sh = act.new_sharers.reshape(R, W).T.reshape(W, P, T)
+    old_row = jnp.where(hit[None], entry_sharers,
+                        jnp.where(can_alloc[None], vic_e_sharers,
+                                  jnp.uint64(0)))
+    delta_sh = new_sh - old_row
+    fidx_rep = jnp.where(writer, fidx, jnp.int32(2**30))
+    req_word = (tile_of // 64).astype(jnp.int32)
+    req_bit = jnp.uint64(1) << (tile_of % 64).astype(jnp.uint64)
+    own_word = jnp.take_along_axis(
+        entry_sharers.transpose(1, 2, 0), req_word[..., None],
+        axis=2)[..., 0]
+    member_add = member & served \
+        & ((own_word & req_bit) == jnp.uint64(0))
+    plane = jnp.arange(W, dtype=jnp.int32)[:, None, None] * A + way[None]
+    add_rows = jnp.concatenate(
+        [plane.reshape(-1), (req_word * A + way).reshape(-1)])
+    add_cols = jnp.concatenate(
+        [jnp.broadcast_to(fidx_rep[None], (W, P, T)).reshape(-1),
+         jnp.where(member_add, fidx, jnp.int32(2**30)).reshape(-1)])
+    add_vals = jnp.concatenate(
+        [delta_sh.reshape(-1), req_bit.reshape(-1)])
+    state = state._replace(dir_sharers=state.dir_sharers.at[
+        add_rows, add_cols].add(add_vals, mode="drop"))
+
+    # ---- banked-install victims: DRAM writeback occupancy for dirty
+    # ones + home-directory notify for live ones (same semantics as the
+    # round loop's chain-victim path).
+    cvic = state.mq_victim
+    vt = cvic >> 3
+    vs = (cvic & 7).astype(jnp.int32)
+    vic_live = served & (vs != I)
+    if shared_l2:
+        state = _sh_l1_evict_notify(
+            params, state, tile_of.reshape(R), vt.reshape(R),
+            vs.reshape(R), vic_live.reshape(R))
+        victim_dirty = vic_live & (vs == M)
+    else:
+        victim_dirty = served & ((vs == M) | (vs == O))
+        victim_home = dram_site_of_line(params, vt)
+        if params.dram.queue_model_enabled:
+            r3 = queue_models.insert_busy(
+                state.dram_ring_start, state.dram_ring_end,
+                state.dram_ring_ptr, victim_home.reshape(R),
+                (issue0 + net_req + dir_ps).reshape(R), dram_service_ps,
+                victim_dirty.reshape(R))
+            state = state._replace(dram_ring_start=r3[0],
+                                   dram_ring_end=r3[1],
+                                   dram_ring_ptr=r3[2])
+        state = _dir_evict_notify(
+            params, state, tile_of.reshape(R), vt.reshape(R),
+            vs.reshape(R), vic_live.reshape(R))
+
+    # ---- MESI slice E grant raises the banked S install in place
+    if params.protocol_kind == "sh_l2_mesi":
+        granted_e = served & ~is_ex \
+            & (act.new_state.reshape(P, T) == E)
+        state = state._replace(l1d=cachemod.raise_line_state(
+            state.l1d, tile_of.reshape(R), jnp.maximum(line, 0).reshape(R),
+            (granted_e & ~is_if).reshape(R), E, params.l1d.num_sets))
+
+    # ---- miss-type classification (fast pass sees no coherence
+    # take-aways, so inv marks stay; fills mark 'seen')
+    if params.track_miss_types:
+        HF = state.seen_filter.shape[1]
+        fslot = (dense.fmix64(line) % jnp.uint64(HF)).astype(jnp.int32)
+        key32 = (jnp.maximum(line, 0) + 1).astype(jnp.int32)
+        seen_v = state.seen_filter[tile_of, fslot] == key32
+        inv_v = state.inv_filter[tile_of, fslot] == key32
+        c0 = state.counters
+        state = state._replace(counters=c0._replace(
+            l2_miss_cold=c0.l2_miss_cold + jnp.sum(
+                served & ~inv_v & ~seen_v, axis=0),
+            l2_miss_capacity=c0.l2_miss_capacity + jnp.sum(
+                served & ~inv_v & seen_v, axis=0),
+            l2_miss_sharing=c0.l2_miss_sharing + jnp.sum(
+                served & inv_v, axis=0)))
+        state = state._replace(
+            seen_filter=state.seen_filter.at[
+                jnp.where(served, tile_of, T), fslot].set(
+                key32, mode="drop"),
+            inv_filter=state.inv_filter.at[
+                jnp.where(served & inv_v, tile_of, T), fslot].set(
+                0, mode="drop"))
+
+    # ---- counters
+    flits_req = noc.num_flits(CTRL_BYTES, params.net_memory.flit_width_bits)
+    flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
+                               params.net_memory.flit_width_bits)
+    b = lambda m: m.astype(jnp.int64)
+    home_cols = [
+        b(served & ~is_ex), b(served & is_ex),      # dir_sh/ex_req
+        b(evicting & served),                       # dir_evictions
+        b(served),                                  # net_mem_pkts @home
+        jnp.where(served, flits_data, 0),           # net_mem_flits @home
+    ]
+    if shared_l2:
+        home_cols += [b(served), b(served & ~hit)]  # l2_access, l2_miss
+    hstack = jnp.stack([h.reshape(R) for h in home_cols], axis=1)
+    hb = jnp.zeros((T, hstack.shape[1]), dtype=jnp.int64).at[
+        home.reshape(R)].add(hstack)
+    db = jnp.zeros((T,), dtype=jnp.int64).at[
+        jnp.where(need_read, dsite, T).reshape(R)].add(
+        1, mode="drop")
+    if shared_l2:
+        vic_wr = 0
+    else:
+        vic_wr = jnp.zeros((T,), dtype=jnp.int64).at[
+            jnp.where(victim_dirty, victim_home, T).reshape(R)].add(
+            1, mode="drop")
+    c = state.counters
+    tsum = lambda m: jnp.sum(m, axis=0, dtype=jnp.int64)
+    c = c._replace(
+        dir_sh_req=c.dir_sh_req + hb[:, 0],
+        dir_ex_req=c.dir_ex_req + hb[:, 1],
+        dir_evictions=c.dir_evictions + hb[:, 2],
+        dram_reads=c.dram_reads + db,
+        dram_writes=c.dram_writes + vic_wr,
+        l2_access=c.l2_access + (hb[:, 5] if shared_l2 else 0),
+        l2_miss=c.l2_miss + (hb[:, 6] if shared_l2 else 0),
+        net_mem_pkts=c.net_mem_pkts + tsum(served) + tsum(victim_dirty)
+        + hb[:, 3],
+        net_mem_flits=c.net_mem_flits
+        + tsum(served) * flits_req + tsum(victim_dirty) * flits_data
+        + hb[:, 4],
+        mem_stall_ps=c.mem_stall_ps + jnp.sum(
+            jnp.where(served, completion - issue, 0), axis=0),
+    )
+    state = state._replace(counters=c)
+
+    # ---- chain bookkeeping: base = last served completion; drained
+    # chains restore the absolute clock.
+    any_srv = n_new > 0
+    last_idx = jnp.minimum(first_slow, state.mq_count) - 1
+    last_oh = slots == last_idx[None, :]
+    last_comp = jnp.sum(jnp.where(last_oh & served, completion, 0), axis=0)
+    new_base = jnp.where(any_srv, last_comp, state.chain_base)
+    drained = (state.mq_count > 0) & (first_slow >= state.mq_count)
+    state = state._replace(
+        mq_head=jnp.where(drained, 0,
+                          jnp.maximum(first_slow, head)),
+        mq_count=jnp.where(drained, 0, state.mq_count),
+        chain_base=jnp.where(drained, 0, new_base),
+        clock=jnp.where(drained, new_base + state.chain_rel, state.clock),
+        chain_rel=jnp.where(drained, 0, state.chain_rel),
+        round_ctr=state.round_ctr + 1,
+    )
+    return state
+
+
 def resolve_memory(params: SimParams, state: SimState) -> SimState:
     """Serve all parked L2-miss requests through the home directories.
 
@@ -240,6 +652,13 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                                params.net_memory.flit_width_bits)
     dense_tables = T * H <= _DENSE_MAX_ELEMS
     slots_p = jnp.arange(max(P, 1), dtype=jnp.int32)[:, None]
+
+    # Vectorized fast pass first: serves every non-conflicting chain
+    # element in one shot; the round loop below handles the leftovers
+    # (conflicting lines, owner legs, invalidation fan-outs, iocoom).
+    if P > 0 and params.core.model == "simple" \
+            and (P * T) * (P * T) <= (1 << 26):
+        state = chain_fast_pass(params, state)
 
     def _parked(st):
         k = st.pend_kind
@@ -1303,11 +1722,12 @@ def _dir_evict_notify(params: SimParams, state: SimState, tiles, vtag,
     fm = jnp.where(drop_m, p.vfidx, jnp.int32(2**30))
     clr = drop_s | drop_o
     fc = jnp.where(clr & p.has_bit, p.vfidx, jnp.int32(2**30))
+    R = tiles.shape[0]          # == T from the round loop, P*T vectorized
     plane = jnp.arange(W, dtype=jnp.int32)[:, None] * A + p.way[None, :]
     rows2 = jnp.concatenate(
         [plane.reshape(-1), p.word * A + p.way])
     cols2 = jnp.concatenate(
-        [jnp.broadcast_to(fm[None, :], (W, T)).reshape(-1), fc])
+        [jnp.broadcast_to(fm[None, :], (W, R)).reshape(-1), fc])
     vals2 = jnp.concatenate(
         [(jnp.uint64(0) - p.esharers.T).reshape(-1),
          jnp.uint64(0) - p.bit])
