@@ -24,7 +24,8 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 15  # v15: DRAM busy-interval ring (history_list role);
+_SCHEMA_VERSION = 16  # v16: dram_qacc moment accumulators (m_g_1 queue model);
+#   v15: DRAM busy-interval ring (history_list role);
 #   v14: banked miss-chain arrays (mq_*, chain_*);
 #   v13: packed int64 dir_word (tag|stamp|owner|state);
 #   v4: packed int32 cache/dir metadata layout;

@@ -13,6 +13,9 @@ common/network/network_model.h:39-207 and common/network/models/):
     carried in ``SimState.link_free_mem``); resolve prices every memory-
     network unicast leg through it when this model is selected.  The
     functions here still supply the zero-load forms for multicasts.
+  * ``atac`` — hybrid optical broadcast network, analytic form in
+    engine/noc_atac.py (network_model_atac.cc); dispatched from the
+    functions here.
 
 All functions are elementwise over [K]-shaped tile-id arrays so one call
 prices every in-flight packet at once.  Tiles are laid out row-major on a
@@ -54,6 +57,9 @@ def unicast_ps(net: NetworkParams, src, dst, payload_bytes,
     """
     if net.model == "magic":
         return jnp.zeros(jnp.shape(src), dtype=jnp.int64)
+    if net.model == "atac":
+        from graphite_tpu.engine import noc_atac
+        return noc_atac.unicast_ps(net, src, dst, payload_bytes, period_ps)
     hops = hop_count(src, dst, mesh_width)
     flits = num_flits(payload_bytes, net.flit_width_bits)
     cycles = hops * (net.router_delay_cycles + net.link_delay_cycles) \
@@ -72,6 +78,10 @@ def max_hop_to_mask_ps(net: NetworkParams, src, tile_mask,
     """
     if net.model == "magic":
         return jnp.zeros(jnp.shape(src), dtype=jnp.int64)
+    if net.model == "atac":
+        from graphite_tpu.engine import noc_atac
+        return noc_atac.max_to_mask_ps(net, src, tile_mask, payload_bytes,
+                                       period_ps)
     T = tile_mask.shape[-1]
     tiles = jnp.arange(T)
     hops = hop_count(src[:, None], tiles[None, :], mesh_width)  # [K, T]

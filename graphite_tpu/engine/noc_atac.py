@@ -1,0 +1,155 @@
+"""ATAC hybrid optical-broadcast network — analytic latency model.
+
+The reference's headline NoC (reference: common/network/models/
+network_model_atac.{h,cc}; ATAC = electrical mesh clusters + an optical
+broadcast waveguide between per-cluster hubs + star/htree receive
+networks inside each cluster):
+
+  * **ENet** — the full-chip electrical mesh; intra-cluster traffic (and,
+    under ``distance_based`` routing, short unicasts) takes plain XY hops
+    on it (routePacketOnENet, network_model_atac.cc:370-404).
+  * **ONet** — cross-cluster traffic rides sender ENet -> nearest optical
+    access point -> the cluster's send hub -> optical waveguide -> the
+    destination cluster's receive hub (routePacketOnONet, :407-478).
+  * **Receive net** — hub to destination tile via a star router + link
+    (or an htree link), ``num_receive_networks_per_cluster`` of them
+    (:480-540).
+
+This module prices those paths in zero-load analytic form — the
+reference's contention queue models per router port are deliberately
+deferred (the repo's contended NoC machinery, noc_flight.py, covers the
+electrical mesh; optical-hub contention is future work and documented as
+such).  All geometry tables are derived once per (static) AtacParams and
+baked into the jitted program as constants.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.params import AtacParams, NetworkParams
+
+
+@lru_cache(maxsize=8)
+def geometry(a: AtacParams):
+    """Static per-tile tables: (cluster_of [T], ap_hops [T], hub_of [C]).
+
+    cluster_of: cluster id per tile (getClusterID).
+    ap_hops: XY hops from a tile to its nearest optical access point —
+      access points sit at sub-cluster centers (initializeAccessPointList,
+      network_model_atac.cc:641-657).
+    hub_of: the hub tile of each cluster (getTileIDWithOpticalHub) —
+      cluster center.
+    """
+    T, W = a.num_tiles, a.enet_width
+    t = np.arange(T)
+    x, y = t % W, t // W
+    cx, cy = x // a.cluster_width, y // a.cluster_height
+    cluster_of = cy * a.numx_clusters + cx
+
+    # Sub-cluster factorization (initializeClusters: even log2 -> square,
+    # odd -> 2:1 in x) over num_access_points sub-clusters per cluster.
+    nsub = max(1, min(a.num_access_points, a.cluster_size))
+    lg = nsub.bit_length() - 1
+    if nsub != 1 << lg:          # non-power-of-two: fall back to 1 AP
+        nsub, lg = 1, 0
+    if lg % 2 == 0:
+        sx = sy = 1 << (lg // 2)
+    else:
+        sx, sy = 1 << ((lg + 1) // 2), 1 << ((lg - 1) // 2)
+    sub_w = max(1, a.cluster_width // sx)
+    sub_h = max(1, a.cluster_height // sy)
+    # Access point of each tile's sub-cluster, at the sub-cluster center.
+    bound_x, bound_y = cx * a.cluster_width, cy * a.cluster_height
+    pos_x = np.minimum((x - bound_x) // sub_w, sx - 1)
+    pos_y = np.minimum((y - bound_y) // sub_h, sy - 1)
+    ap_x = bound_x + pos_x * sub_w + sub_w // 2
+    ap_y = bound_y + pos_y * sub_h + sub_h // 2
+    ap_hops = np.abs(x - ap_x) + np.abs(y - ap_y)
+
+    # hub_of is not consumed by the pricing (the ONet is distance-
+    # independent past the access point — that is ATAC's point); it is
+    # exposed for tests and topology inspection.
+    c = np.arange(a.num_clusters)
+    hub_x = (c % a.numx_clusters) * a.cluster_width + a.cluster_width // 2
+    hub_y = (c // a.numx_clusters) * a.cluster_height + a.cluster_height // 2
+    hub_of = hub_y * W + hub_x
+    return (jnp.asarray(cluster_of, jnp.int32),
+            jnp.asarray(ap_hops, jnp.int32),
+            jnp.asarray(hub_of, jnp.int32))
+
+
+def _enet_cycles(a: AtacParams, net: NetworkParams, src, dst):
+    """XY hop cycles on the electrical mesh (routePacketOnENet)."""
+    W = a.enet_width
+    sx, sy = src % W, src // W
+    dx, dy = dst % W, dst // W
+    hops = jnp.abs(sx - dx) + jnp.abs(sy - dy)
+    return hops * (net.router_delay_cycles + net.link_delay_cycles)
+
+
+def _onet_cycles(a: AtacParams, net: NetworkParams, src):
+    """Cycles from ``src`` to ANY remote cluster's receive net output —
+    the optical path is distance-independent (that is ATAC's point):
+    src -> nearest access point (ENet) -> hub port hop -> send hub router
+    -> optical link -> receive hub router -> star/htree receive leg.
+    """
+    _, ap_hops, _ = geometry(a)
+    per_hop = net.router_delay_cycles + net.link_delay_cycles
+    recv = a.star_net_router_delay + net.link_delay_cycles \
+        if a.receive_net_type == "star" else net.link_delay_cycles
+    return (ap_hops[src] * per_hop          # ENet to the access point
+            + per_hop                       # access-point port -> hub
+            + a.send_hub_router_delay
+            + a.optical_link_delay_cycles
+            + a.receive_hub_router_delay
+            + recv)
+
+
+def unicast_cycles(net: NetworkParams, src, dst):
+    """Zero-load unicast cycles src -> dst under ATAC routing
+    (computeGlobalRoute, network_model_atac.cc:798-820): same cluster ->
+    ENet; cross-cluster -> ONet (cluster_based) or ENet when within the
+    unicast distance threshold (distance_based)."""
+    a = net.atac
+    cluster_of, _, _ = geometry(a)
+    enet = _enet_cycles(a, net, src, dst)
+    onet = _onet_cycles(a, net, src)
+    same = cluster_of[src] == cluster_of[dst]
+    if a.global_routing_strategy == "distance_based":
+        W = a.enet_width
+        hops = (jnp.abs(src % W - dst % W)
+                + jnp.abs(src // W - dst // W))
+        use_enet = same | (hops <= a.unicast_distance_threshold)
+    else:
+        use_enet = same
+    return jnp.where(use_enet, enet, onet)
+
+
+def unicast_ps(net: NetworkParams, src, dst, payload_bytes, period_ps):
+    from graphite_tpu.engine import noc
+    flits = noc.num_flits(payload_bytes, net.flit_width_bits)
+    cycles = unicast_cycles(net, src, dst) + jnp.maximum(flits - 1, 0)
+    return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)
+
+
+def max_to_mask_ps(net: NetworkParams, src, tile_mask, payload_bytes,
+                   period_ps):
+    """Farthest-unicast bound over a [K, T] destination mask (the
+    directory's invalidation fan-out charge).  Each destination is priced
+    by its own route (ENet or ONet) — the optical broadcast reaches every
+    remote cluster at one latency, so the max is typically the ONet
+    constant or the longest intra-cluster ENet leg."""
+    from graphite_tpu.engine import noc
+    a = net.atac
+    T = tile_mask.shape[-1]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    cyc = unicast_cycles(net, src[:, None], tiles[None, :])    # [K, T]
+    max_cyc = jnp.max(jnp.where(tile_mask, cyc, 0), axis=-1)
+    flits = noc.num_flits(payload_bytes, net.flit_width_bits)
+    cycles = jnp.where(tile_mask.any(axis=-1),
+                      max_cyc + jnp.maximum(flits - 1, 0), 0)
+    return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)

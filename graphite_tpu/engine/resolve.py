@@ -223,12 +223,17 @@ def chain_fast_pass(params: SimParams, state: SimState) -> SimState:
     element (chain order is a strict prefix); everything after stays
     banked for the exact conflict-round loop that follows.
 
-    Approximations vs the round loop (all within the lax model's slack,
-    see tests/test_chain_equivalence.py): DRAM queue delays are computed
+    Approximations vs the round loop: DRAM queue delays are computed
     against pre-correction arrival times (one fixpoint iteration), and
     same-(home,dset) allocation ranks order by chain position rather
     than exact issue time.  Simple in-order cores only (iocoom chains
     thread their LQ/SQ rings through the round loop).
+
+    STATUS: tests/test_chain_equivalence.py measures this path against
+    the one-parked-request oracle; it does NOT yet match (r4: +64 % on
+    radix — zero-load NoC pricing and skipped link/line serialization
+    under-price contention), so ``tpu/miss_chain`` defaults to 0 and
+    this pass is opt-in until the equivalence tests pass.
     """
     P = params.miss_chain
     T = params.num_tiles
@@ -430,15 +435,16 @@ def chain_fast_pass(params: SimParams, state: SimState) -> SimState:
     # later elements inherit its earlier elements' delays (prefix).
     if params.dram.queue_model_enabled:
         arr = issue0 + net_req + dir_ps + to_dram_ps
-        q = queue_models.fcfs_ring(
+        _, _, delay_f, rs_, re_, rp_, mg1_ = queue_models.probe(
+            params.dram.queue_model_type,
             dsite.reshape(R), arr.reshape(R),
             jnp.full((R,), dram_service_ps), need_read.reshape(R),
             state.dram_ring_start, state.dram_ring_end,
-            state.dram_ring_ptr)
-        delay = q.delay.reshape(P, T)
-        state = state._replace(dram_ring_start=q.ring_start,
-                               dram_ring_end=q.ring_end,
-                               dram_ring_ptr=q.ring_ptr)
+            state.dram_ring_ptr, state.dram_qacc,
+            ma_window=params.dram.basic_ma_window)
+        delay = delay_f.reshape(P, T)
+        state = state._replace(dram_ring_start=rs_, dram_ring_end=re_,
+                               dram_ring_ptr=rp_, dram_qacc=mg1_)
     else:
         delay = jnp.zeros((P, T), jnp.int64)
     cum_delay = _cumsum_p(jnp.where(served, delay, 0))
@@ -495,14 +501,17 @@ def chain_fast_pass(params: SimParams, state: SimState) -> SimState:
         victim_dirty = served & ((vs == M) | (vs == O))
         victim_home = dram_site_of_line(params, vt)
         if params.dram.queue_model_enabled:
-            r3 = queue_models.insert_busy(
+            r3 = queue_models.occupy(
+                params.dram.queue_model_type,
                 state.dram_ring_start, state.dram_ring_end,
-                state.dram_ring_ptr, victim_home.reshape(R),
+                state.dram_ring_ptr, state.dram_qacc,
+                victim_home.reshape(R),
                 (issue0 + net_req + dir_ps).reshape(R), dram_service_ps,
-                victim_dirty.reshape(R))
+                victim_dirty.reshape(R),
+                ma_window=params.dram.basic_ma_window)
             state = state._replace(dram_ring_start=r3[0],
                                    dram_ring_end=r3[1],
-                                   dram_ring_ptr=r3[2])
+                                   dram_ring_ptr=r3[2], dram_qacc=r3[3])
         state = _dir_evict_notify(
             params, state, tile_of.reshape(R), vt.reshape(R),
             vs.reshape(R), vic_live.reshape(R))
@@ -1178,16 +1187,17 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # section below.
         dram_wb = (act.dram_write & win) | evict_m | evict_o
         if params.dram.queue_model_enabled:
-            q = queue_models.fcfs_ring(
+            q_start, _, _, rs_, re_, rp_, mg1_ = queue_models.probe(
+                params.dram.queue_model_type,
                 dsite, dram_arrival, jnp.full(T, dram_service_ps),
                 need_read, state.dram_ring_start, state.dram_ring_end,
-                state.dram_ring_ptr,
+                state.dram_ring_ptr, state.dram_qacc,
                 occ_res=dsite, occ_arr=dram_arrival,
-                occ_svc=jnp.full(T, dram_service_ps), occ_valid=dram_wb)
-            state = state._replace(dram_ring_start=q.ring_start,
-                                   dram_ring_end=q.ring_end,
-                                   dram_ring_ptr=q.ring_ptr)
-            dram_start = q.start
+                occ_svc=jnp.full(T, dram_service_ps), occ_valid=dram_wb,
+                ma_window=params.dram.basic_ma_window)
+            state = state._replace(dram_ring_start=rs_, dram_ring_end=re_,
+                                   dram_ring_ptr=rp_, dram_qacc=mg1_)
+            dram_start = jnp.where(need_read, q_start, 0)
         else:
             # [dram/queue_model] enabled=false: no queueing delay, no
             # occupancy tracking (reference DramPerfModel without a
@@ -1408,13 +1418,15 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             victim_live = win & (vs1 != I)
             victim_home = dram_site_of_line(params, vt1)
             if params.dram.queue_model_enabled:
-                r3 = queue_models.insert_busy(
+                r3 = queue_models.occupy(
+                    params.dram.queue_model_type,
                     state.dram_ring_start, state.dram_ring_end,
-                    state.dram_ring_ptr, victim_home, t_dir,
-                    dram_service_ps, victim_dirty)
+                    state.dram_ring_ptr, state.dram_qacc,
+                    victim_home, t_dir, dram_service_ps, victim_dirty,
+                    ma_window=params.dram.basic_ma_window)
                 state = state._replace(dram_ring_start=r3[0],
                                        dram_ring_end=r3[1],
-                                       dram_ring_ptr=r3[2])
+                                       dram_ring_ptr=r3[2], dram_qacc=r3[3])
             # Notify the victim line's home directory (reference sends
             # eviction writebacks that downgrade the entry; silently
             # dropping them left stale owners/sharer bits that charge
@@ -1538,14 +1550,6 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         else:
             unpark = completion
 
-        # Parked winners unblock (cursor advance + stall accounting).
-        import os
-        if os.environ.get("GTPU_DEBUG_RESOLVE"):
-            jax.debug.print(
-                "RB t0 win={w} line={l} issue={i} arrive={a} tdir={td} "
-                "tdata={tv} unpark={u}",
-                w=win[0], l=line[0], i=issue[0], a=arrive[0],
-                td=t_dir[0], tv=t_data[0], u=unpark[0])
         # Parked winners unblock (cursor advance + stall accounting;
         # P > 0 has no memory parks — the complex slot banks instead).
         if P == 0:

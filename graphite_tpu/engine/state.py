@@ -307,6 +307,13 @@ class SimState(NamedTuple):
     dram_ring_start: jnp.ndarray  # [R, T] int64 busy-interval starts
     dram_ring_end: jnp.ndarray    # [R, T] int64 busy-interval ends
     dram_ring_ptr: jnp.ndarray    # [T] int32 next ring slot
+    # Queue-model accumulators per controller, [6, T] float64:
+    # rows 0-3 = m_g_1 service moments (sum_s, sum_s_sq, n, newest
+    # arrival — reference queue_model_m_g_1.h:14-20), rows 4-5 = the
+    # basic model's moving-average state (ema mean, effective sample
+    # count — reference queue_model_basic.cc + moving_average.h).  Only
+    # the rows of the configured [dram/queue_model] type are consumed.
+    dram_qacc: jnp.ndarray         # [6, T] float64
 
     # -- mesh link horizons (emesh_hop_by_hop contention; reference:
     # per-link queue models in network_model_emesh_hop_by_hop.cc)
@@ -487,6 +494,7 @@ def make_state(params: SimParams,
         dram_ring_start=jnp.zeros((DRAM_RING_SLOTS, T), dtype=jnp.int64),
         dram_ring_end=jnp.zeros((DRAM_RING_SLOTS, T), dtype=jnp.int64),
         dram_ring_ptr=jnp.zeros(T, dtype=jnp.int32),
+        dram_qacc=jnp.zeros((6, T), dtype=jnp.float64),
         link_free_mem=noc_flight.make_link_free(T),
         lock_holder=jnp.zeros(max_mutexes, dtype=jnp.int32),
         lock_free_at=jnp.zeros(max_mutexes, dtype=jnp.int64),

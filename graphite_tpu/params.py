@@ -198,6 +198,52 @@ def _auto_directory_access_cycles(total_entries: int, num_tiles: int,
     return 20
 
 
+QUEUE_MODEL_TYPES = ("basic", "history_list", "history_tree", "m_g_1")
+_QUEUE_MODEL_TYPES = QUEUE_MODEL_TYPES
+
+
+def _queue_model_type(val: str, key: str) -> str:
+    """Queue-model selection fails loudly on unknown types, matching the
+    reference factory (QueueModel::create, queue_model.cc:18-37 —
+    LOG_PRINT_ERROR on anything it doesn't know).  ``m_g_1`` is accepted
+    directly (the reference embeds it inside history_tree;
+    queue_model_m_g_1.cc is its own class)."""
+    if val not in _QUEUE_MODEL_TYPES:
+        raise ConfigError(
+            f"{key} = {val!r}: unknown queue model (valid: "
+            f"{', '.join(_QUEUE_MODEL_TYPES)})")
+    return val
+
+
+def _basic_ma_window(cfg: Config) -> int:
+    """[queue_model/basic] moving-average window (reference
+    queue_model_basic.cc:14-31): 0 when disabled; only arithmetic_mean
+    is implemented — other averagers fail loudly."""
+    if not cfg.get_bool("queue_model/basic/moving_avg_enabled", False):
+        return 0
+    ma_type = cfg.get_str("queue_model/basic/moving_avg_type",
+                          "arithmetic_mean")
+    if ma_type != "arithmetic_mean":
+        raise ConfigError(
+            f"queue_model/basic/moving_avg_type = {ma_type!r} is not "
+            f"implemented (supported: arithmetic_mean)")
+    w = cfg.get_int("queue_model/basic/moving_avg_window_size", 1)
+    if w <= 0:
+        raise ConfigError(
+            f"queue_model/basic/moving_avg_window_size must be positive, "
+            f"got {w}")
+    return w
+
+
+def _link_queue_model_type(val: str, key: str) -> str:
+    if val not in ("basic", "history_list", "history_tree"):
+        raise ConfigError(
+            f"{key} = {val!r}: unknown link queue model (valid: basic, "
+            f"history_list, history_tree — the reference factory's set, "
+            f"queue_model.cc:18-37)")
+    return val
+
+
 @dataclasses.dataclass(frozen=True)
 class DramParams:
     """DRAM controller timing (reference: [dram] section;
@@ -210,6 +256,10 @@ class DramParams:
     controller_home_stride: int   # tiles between successive controllers
     queue_model_enabled: bool
     queue_model_type: str
+    # [queue_model/basic] moving average: effective window size, 0 when
+    # disabled (reference queue_model_basic.cc reads moving_avg_enabled/
+    # window_size/type; only arithmetic_mean is implemented here).
+    basic_ma_window: int = 0
 
     @property
     def latency_ps(self) -> int:
@@ -233,7 +283,127 @@ class DramParams:
             num_controllers=n,
             controller_home_stride=stride,
             queue_model_enabled=cfg.get_bool("dram/queue_model/enabled"),
-            queue_model_type=cfg.get_str("dram/queue_model/type"),
+            queue_model_type=_queue_model_type(
+                cfg.get_str("dram/queue_model/type"), "dram/queue_model/type"),
+            basic_ma_window=_basic_ma_window(cfg),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AtacParams:
+    """ATAC hybrid optical-broadcast network geometry + delays
+    (reference: network_model_atac.{h,cc}, [network/atac]
+    carbon_sim.cfg:315-352).  All fields are scalars so SimParams stays
+    hashable (jit static arg); per-tile tables derive from these in
+    engine/noc_atac.py.
+    """
+
+    num_tiles: int
+    enet_width: int
+    enet_height: int
+    cluster_size: int
+    num_clusters: int
+    numx_clusters: int
+    numy_clusters: int
+    cluster_width: int
+    cluster_height: int
+    num_access_points: int            # per cluster
+    receive_net_type: str             # star | htree
+    global_routing_strategy: str      # cluster_based | distance_based
+    unicast_distance_threshold: int
+    send_hub_router_delay: int        # cycles
+    receive_hub_router_delay: int     # cycles
+    star_net_router_delay: int        # cycles
+    optical_link_delay_cycles: int    # EO + waveguide + OE, at init freq
+
+    @classmethod
+    def from_config(cls, cfg: Config, num_tiles: int,
+                    net_freq_ghz: float) -> "AtacParams":
+        # ENet sizing per the reference (isTileCountPermissible,
+        # network_model_atac.cc:844-856 — same rule as the electrical
+        # mesh): w = floor(sqrt(T)), h = ceil(T/w), T must fill the grid.
+        w = int(math.floor(math.sqrt(num_tiles)))
+        h = int(math.ceil(num_tiles / w))
+        if num_tiles != w * h:
+            raise ConfigError(
+                f"network/atac: can't form a mesh with tile count "
+                f"{num_tiles} (reference isTileCountPermissible)")
+        csize = cfg.get_int("network/atac/cluster_size", 4)
+        if csize <= 0 or num_tiles % csize:
+            raise ConfigError(
+                f"network/atac/cluster_size = {csize} must divide the "
+                f"tile count {num_tiles}")
+        nclust = num_tiles // csize
+        # Cluster grid factorization (reference initializeClusters,
+        # network_model_atac.cc:594-618): even log2 -> square; odd ->
+        # numX = sqrt(n/2), numY = sqrt(2n) (tall).  The reference's
+        # sqrt math silently assumes a power-of-two cluster count; here
+        # that assumption is a loud check.
+        lg = nclust.bit_length() - 1
+        if nclust != 1 << lg:
+            raise ConfigError(
+                f"network/atac: cluster count {nclust} must be a power "
+                f"of two (reference initializeClusters sqrt math)")
+        if lg % 2 == 0:
+            nx = ny = 1 << (lg // 2)
+        else:
+            nx = 1 << ((lg - 1) // 2)
+            ny = 1 << ((lg + 1) // 2)
+        cw, ch = w // nx, h // ny
+        if cw * nx != w or ch * ny != h:
+            raise ConfigError(
+                f"network/atac: cluster grid {nx}x{ny} does not tile the "
+                f"{w}x{h} ENet evenly")
+        # Optical waveguide length (mm) per the reference's cases
+        # (computeOpticalLinkLength, network_model_atac.cc:560-585).
+        tile_w = cfg.get_float("general/tile_width", 1.0)
+        if nclust == 2:
+            length = ch * tile_w
+        elif nclust == 4:
+            length = (cw * tile_w) * (ch * tile_w)
+        elif nclust == 8:
+            length = (cw * tile_w) * (2 * ch * tile_w)
+        else:
+            rect_l = (nx - 2) * cw * tile_w
+            rect_h = (ch * 2) * tile_w
+            length = max(ny // 4, 1) * 2 * (rect_l + rect_h)
+        wg_ns_per_mm = cfg.get_float(
+            "link_model/optical/waveguide_delay_per_mm", 10e-3)
+        eo = cfg.get_int("link_model/optical/E-O_conversion_delay", 1)
+        oe = cfg.get_int("link_model/optical/O-E_conversion_delay", 1)
+        # Cycle count fixed at the network's initial frequency, as the
+        # reference computes it once at init (optical_link_model.cc:51-54).
+        optical_cycles = int(math.ceil(
+            wg_ns_per_mm * length * net_freq_ghz + eo + oe))
+        rnet = cfg.get_str("network/atac/receive_network_type", "star")
+        if rnet not in ("star", "btree"):
+            raise ConfigError(
+                f"network/atac/receive_network_type = {rnet!r} "
+                f"(valid: star, btree — reference parseReceiveNetType)")
+        strat = cfg.get_str("network/atac/global_routing_strategy",
+                            "cluster_based")
+        if strat not in ("cluster_based", "distance_based"):
+            raise ConfigError(
+                f"network/atac/global_routing_strategy = {strat!r} "
+                f"(valid: cluster_based, distance_based)")
+        return cls(
+            num_tiles=num_tiles, enet_width=w, enet_height=h,
+            cluster_size=csize, num_clusters=nclust,
+            numx_clusters=nx, numy_clusters=ny,
+            cluster_width=cw, cluster_height=ch,
+            num_access_points=cfg.get_int(
+                "network/atac/num_optical_access_points_per_cluster", 4),
+            receive_net_type=rnet,
+            global_routing_strategy=strat,
+            unicast_distance_threshold=cfg.get_int(
+                "network/atac/unicast_distance_threshold", 4),
+            send_hub_router_delay=cfg.get_int(
+                "network/atac/onet/send_hub/router/delay", 1),
+            receive_hub_router_delay=cfg.get_int(
+                "network/atac/onet/receive_hub/router/delay", 1),
+            star_net_router_delay=cfg.get_int(
+                "network/atac/star_net/router/delay", 1),
+            optical_link_delay_cycles=optical_cycles,
         )
 
 
@@ -250,21 +420,40 @@ class NetworkParams:
     queue_model_enabled: bool
     queue_model_type: str
     broadcast_tree_enabled: bool
+    atac: Optional[AtacParams] = None
 
     @classmethod
-    def from_config(cls, cfg: Config, which: str) -> "NetworkParams":
+    def from_config(cls, cfg: Config, which: str, num_tiles: int,
+                    net_freq_ghz: float) -> "NetworkParams":
         model = cfg.get_str(f"network/{which}")
         sec = f"network/{model}"
         if model == "magic":
             return cls(model, 64, 0, 0, False, "none", False)
+        atac = None
+        if model == "atac":
+            atac = AtacParams.from_config(cfg, num_tiles, net_freq_ghz)
         return cls(
             model=model,
             flit_width_bits=cfg.get_int(f"{sec}/flit_width", 64),
-            router_delay_cycles=cfg.get_int(f"{sec}/router/delay", 1),
+            # ATAC's electrical mesh (ENet) reuses the emesh router/link
+            # delays ("ENet is modeled similar to an electrical mesh",
+            # carbon_sim.cfg:331).
+            router_delay_cycles=cfg.get_int(
+                f"{sec}/enet/router/delay" if model == "atac"
+                else f"{sec}/router/delay", 1),
             link_delay_cycles=cfg.get_int(f"{sec}/link/delay", 1),
             queue_model_enabled=cfg.get_bool(f"{sec}/queue_model/enabled", False),
-            queue_model_type=cfg.get_str(f"{sec}/queue_model/type", "history_tree"),
+            # Link queues accept the reference factory's three types
+            # (basic/history_list/history_tree — queue_model.cc:18-37;
+            # m_g_1 is DRAM-only here, as in the reference where it only
+            # exists inside history_tree).  All three map to the exact
+            # per-link FCFS sweep (noc_flight.py) — exact FCFS == basic
+            # for in-order arrivals and >= history fidelity otherwise.
+            queue_model_type=_link_queue_model_type(
+                cfg.get_str(f"{sec}/queue_model/type", "history_tree"),
+                f"{sec}/queue_model/type"),
             broadcast_tree_enabled=cfg.get_bool(f"{sec}/broadcast_tree_enabled", False),
+            atac=atac,
         )
 
 
@@ -486,10 +675,15 @@ class SimParams:
             from graphite_tpu.energy import DVFS_LEVELS
             _check("general/technology_node", self.technology_node,
                    set(DVFS_LEVELS))
+        # (user-network emesh_hop_by_hop stays rejected until its
+        # contention path exists — resolve gates the flight machinery on
+        # the MEMORY network; silently pricing user sends zero-load under
+        # a contended model name would be the exact quiet-divergence this
+        # validator exists to stop.)
         _check("network/user model", self.net_user.model,
-               {"magic", "emesh_hop_counter"})
+               {"magic", "emesh_hop_counter", "atac"})
         _check("network/memory model", self.net_memory.model,
-               {"magic", "emesh_hop_counter", "emesh_hop_by_hop"})
+               {"magic", "emesh_hop_counter", "emesh_hop_by_hop", "atac"})
         _check("branch_predictor/type", self.core.bp_type,
                {"one_bit", "none"})
 
@@ -572,8 +766,12 @@ class SimParams:
             l2_max_hw_sharers=cfg.get_int("l2_directory/max_hw_sharers"),
             directory=directory,
             dram=dram,
-            net_user=NetworkParams.from_config(cfg, "user"),
-            net_memory=NetworkParams.from_config(cfg, "memory"),
+            net_user=NetworkParams.from_config(
+                cfg, "user", num_tiles=T,
+                net_freq_ghz=cfg.get_float("general/max_frequency")),
+            net_memory=NetworkParams.from_config(
+                cfg, "memory", num_tiles=T,
+                net_freq_ghz=cfg.get_float("general/max_frequency")),
             dvfs_domains=parse_dvfs_domains(cfg.get_str("dvfs/domains")),
             dvfs_sync_delay_cycles=cfg.get_int("dvfs/synchronization_delay"),
             syscall_cost_cycles=_syscall_costs(cfg),
@@ -601,7 +799,7 @@ class SimParams:
             max_inv_fanout_per_round=_positive(cfg.get_int(
                 "tpu/max_inv_fanout_per_round", 8),
                 "tpu/max_inv_fanout_per_round"),
-            miss_chain=_nonneg(cfg.get_int("tpu/miss_chain", 12),
+            miss_chain=_nonneg(cfg.get_int("tpu/miss_chain", 0),
                                "tpu/miss_chain"),
             max_resolve_rounds=_positive(
                 cfg.get_int("tpu/max_resolve_rounds", 64),

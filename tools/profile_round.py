@@ -22,16 +22,27 @@ from graphite_tpu.events import synth
 from graphite_tpu.params import SimParams
 
 
-def fused(fn, state, iters):
+def _timed(fn, state, ta, iters):
+    # ta rides as a jit ARGUMENT: closure-capturing the trace arrays
+    # embeds them as HLO literals, which at 1024 tiles overflows the
+    # remote-compile request (HTTP 413) and bloats every cache key.
     @jax.jit
-    def loop(s):
-        return jax.lax.fori_loop(0, iters, lambda i, x: fn(x), s)
+    def loop(s, t):
+        return jax.lax.fori_loop(0, iters, lambda i, x: fn(x, t), s)
 
-    jax.block_until_ready(loop(state))
+    jax.block_until_ready(loop(state, ta))
     t0 = time.perf_counter()
-    out = loop(state)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e6
+    jax.block_until_ready(loop(state, ta))
+    return time.perf_counter() - t0
+
+
+def fused(fn, state, ta, iters):
+    """Marginal per-iteration cost: time the fused loop at ``iters`` and
+    ``2*iters`` and difference — cancels the per-call constant (dispatch +
+    tunnel round trip), which otherwise dominates at small tile counts."""
+    t1 = _timed(fn, state, ta, iters)
+    t2 = _timed(fn, state, ta, 2 * iters)
+    return max(t2 - t1, 0.0) / iters * 1e6
 
 
 def main():
@@ -46,12 +57,12 @@ def main():
     state, ta = sim.state, sim.trace
 
     for name, fn in [
-        ("block", lambda s: _block_retire(params, s, ta)),
-        ("complex", lambda s: _complex_slot(params, s, ta)),
-        ("resolve_memory", lambda s: rs.resolve_memory(params, s)),
-        ("resolve_all", lambda s: rs.resolve(params, s)),
+        ("block", lambda s, t: _block_retire(params, s, t)),
+        ("complex", lambda s, t: _complex_slot(params, s, t)),
+        ("resolve_memory", lambda s, t: rs.resolve_memory(params, s)),
+        ("resolve_all", lambda s, t: rs.resolve(params, s)),
     ]:
-        us = fused(fn, state, iters)
+        us = fused(fn, state, ta, iters)
         print(f"T={T} {name}: {us:.0f} us/round", flush=True)
 
 
