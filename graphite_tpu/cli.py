@@ -58,6 +58,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f.write(text)
         else:
             print(text)
+        # [runtime_energy_modeling/power_trace] enabled=true: write the
+        # per-interval power file beside the summary (reference
+        # carbon_sim.cfg:141-145).
+        if cfg.get_bool("runtime_energy_modeling/power_trace/enabled",
+                        False):
+            ptpath = (args.output or "sim") + ".power.csv"
+            summary.write_power_trace(ptpath)
         return 0
 
     return 2

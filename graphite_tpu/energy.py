@@ -236,3 +236,82 @@ def compute_energy(params, counters: Dict[str, np.ndarray],
     return EnergyBreakdown(core=core, l1i=l1i, l1d=l1d, l2=l2,
                            directory=directory, dram=dram, network=network,
                            leakage=leakage, area_mm2_per_tile=area)
+
+
+# Sampled-series rows produced by quantum._maybe_sample (stat_scalars):
+# indices of the energy-bearing aggregates the power trace consumes.
+_PT_ICOUNT, _PT_MEM_FLITS, _PT_USER_FLITS = 0, 1, 2
+_PT_DRAM_RD, _PT_DRAM_WR = 3, 4
+_PT_L1I, _PT_L1D, _PT_L2, _PT_BRANCH, _PT_DIR = 8, 9, 10, 11, 12
+
+
+def power_trace(params, stat_time: np.ndarray, stat_scalars: np.ndarray,
+                num_samples: int) -> Dict[str, np.ndarray]:
+    """Per-interval power from the periodic counter samples — the
+    reference's [runtime_energy_modeling/power_trace] file
+    (carbon_sim.cfg:141-145; TileEnergyMonitor computes per-interval
+    energy the same counters-times-costs way).
+
+    Returns {"time_ns", "dynamic_w", "leakage_w", "total_w"}, one row per
+    sample interval (diffs of consecutive samples).  Voltages are taken
+    at the configured initial DVFS levels — the sampled series are
+    aggregates, so per-sample per-module voltage reconstruction is out of
+    scope (a DVFS_SET mid-run shifts the true dynamic power of later
+    intervals by the V^2 ratio; documented approximation).
+    """
+    n = int(num_samples)
+    if n < 2:
+        return {"time_ns": np.zeros(0), "dynamic_w": np.zeros(0),
+                "leakage_w": np.zeros(0), "total_w": np.zeros(0)}
+    t = np.asarray(stat_time[:n], np.float64)           # ps
+    s = np.asarray(stat_scalars[:, :n], np.float64)
+    dt_s = np.maximum(np.diff(t), 1.0) * 1e-12
+    d = np.diff(s, axis=1)
+
+    tech = params.technology_node
+    dyn = _NODE_DYN[_node(tech)]
+    vnom = nominal_voltage(tech)
+
+    def vm2(module: DVFSModule) -> float:
+        """(V/Vnom)^2 at the module's initial DVFS frequency — the same
+        scaling compute_energy applies per module (energy.py:181-186),
+        evaluated at the configured starting levels."""
+        v = float(voltage_for_frequency(
+            np.asarray(params.module_freq_ghz(module)),
+            params.max_frequency_ghz, tech))
+        return (v / vnom) ** 2
+
+    e_l1i = _cache_access_pj(params.l1i.size_kb, params.l1i.associativity,
+                             params.l1i.num_banks)
+    e_l1d = _cache_access_pj(params.l1d.size_kb, params.l1d.associativity,
+                             params.l1d.num_banks)
+    e_l2 = _cache_access_pj(params.l2.size_kb, params.l2.associativity,
+                            params.l2.num_banks)
+    mean_hops = max(1.0, (params.mesh_width + params.mesh_height) / 3.0)
+    e_hop = (_E_ROUTER_FLIT_PJ + _E_LINK_FLIT_PJ) * mean_hops
+    de_pj = dyn * (
+        vm2(DVFSModule.CORE) * (_E_INST_PJ * d[_PT_ICOUNT]
+                                + _E_BRANCH_PJ * d[_PT_BRANCH])
+        + vm2(DVFSModule.L1_ICACHE) * e_l1i * d[_PT_L1I]
+        + vm2(DVFSModule.L1_DCACHE) * e_l1d * d[_PT_L1D]
+        + vm2(DVFSModule.L2_CACHE) * e_l2 * d[_PT_L2]
+        + vm2(DVFSModule.DIRECTORY) * _E_DIR_PJ * d[_PT_DIR]
+        + _E_DRAM_PJ_PER_BYTE * params.line_size
+        * (d[_PT_DRAM_RD] + d[_PT_DRAM_WR])
+        + e_hop * (vm2(DVFSModule.NETWORK_MEMORY) * d[_PT_MEM_FLITS]
+                   + vm2(DVFSModule.NETWORK_USER) * d[_PT_USER_FLITS]))
+    dynamic_w = de_pj * 1e-12 / dt_s
+
+    leak_f = _NODE_LEAK[_node(tech)]
+    cache_kb = (params.l1i.size_kb + params.l1d.size_kb
+                + params.l2.size_kb)
+    vscale = math.sqrt(vm2(DVFSModule.CORE))
+    leak_w_tile = leak_f * 1e-3 * vscale * (
+        _LEAK_CORE_MW + _LEAK_CACHE_MW_PER_KB * cache_kb + _LEAK_ROUTER_MW)
+    leakage_w = np.full_like(dynamic_w, leak_w_tile * params.num_tiles)
+    return {
+        "time_ns": t[1:] * 1e-3,
+        "dynamic_w": dynamic_w,
+        "leakage_w": leakage_w,
+        "total_w": dynamic_w + leakage_w,
+    }

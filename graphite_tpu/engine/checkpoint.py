@@ -24,7 +24,10 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 16  # v16: dram_qacc moment accumulators (m_g_1 queue model);
+_SCHEMA_VERSION = 18  # v18: iocoom register scoreboard (reg_ready);
+#   v17: ThreadScheduler seats + stream store (strm_*,
+#       seat_*; stream-indexed spawned_at/done_at);
+#   v16: dram_qacc moment accumulators (m_g_1 queue model);
 #   v15: DRAM busy-interval ring (history_list role);
 #   v14: banked miss-chain arrays (mq_*, chain_*);
 #   v13: packed int64 dir_word (tag|stamp|owner|state);
@@ -66,7 +69,11 @@ def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:
     """
     with np.load(path) as z:
         saved_capi = z["ch_sent"].size > 0
-        template = make_state(params, has_capi=saved_capi)
+        saved_streams = int(z["strm_cursor"].shape[0]) \
+            if "strm_cursor" in z else 0
+        template = make_state(params, has_capi=saved_capi,
+                              num_streams=saved_streams
+                              or params.num_tiles)
         arrays, treedef = _flatten_with_paths(template)
         if int(z["__meta_schema"]) != _SCHEMA_VERSION:
             raise ValueError(

@@ -125,12 +125,18 @@ def _block_retire(params: SimParams, st: SimState,
     tile_active = (~st.done) & (st.pend_kind == PEND_NONE) \
         & (in_chain | (st.clock < st.boundary)) & (st.cursor < N)
 
-    # ---- window gather: next K events per tile (two gathers)
+    # ---- window gather: next K events per tile (two gathers).  With the
+    # ThreadScheduler, each tile reads its SEATED stream's trace row.
     pos = st.cursor[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
     valid_ev = (pos < N) & tile_active[:, None]
     idx = jnp.minimum(pos, N - 1)
-    meta = jnp.take_along_axis(trace.meta, idx[None], axis=2)   # [3, T, K]
-    addr = jnp.take_along_axis(trace.addr, idx, axis=1)         # [T, K]
+    if st.sched_enabled:
+        srow = st.seat_stream
+        meta = trace.meta[:, srow[:, None], idx]                # [3, T, K]
+        addr = trace.addr[srow[:, None], idx]                   # [T, K]
+    else:
+        meta = jnp.take_along_axis(trace.meta, idx[None], axis=2)
+        addr = jnp.take_along_axis(trace.addr, idx, axis=1)
     op, arg, arg2 = meta[0], meta[1], meta[2]
     op = jnp.where(valid_ev, op, EventOp.NOP)
 
@@ -172,6 +178,14 @@ def _block_retire(params: SimParams, st: SimState,
         comp_l2 = is_comp & ~pI.hit & pL2.hit
     mem_simple = is_mem & (l1_ok | mem_l2)
     comp_simple = is_comp & (pI.hit | comp_l2)
+    if params.core.model == "iocoom":
+        # Register-annotated events (scoreboard operands in arg2's high
+        # bits) need the complex slot's RAW floors/writes — decline them
+        # here.  Unannotated traces (arg2 high bits zero) are untouched.
+        annotated = (is_comp & ((arg2 >> 20) != 0)) \
+            | (is_rd & (((arg2 >> 8) & 31) != 0))
+        mem_simple = mem_simple & ~annotated
+        comp_simple = comp_simple & ~annotated
     fill_d = mem_l2                           # L1D fill from local L2 hit
     fill_i = comp_l2                          # L1I fill from local L2 hit
 
@@ -308,7 +322,9 @@ def _block_retire(params: SimParams, st: SimState,
         correct = pred == taken
 
     # ---- per-event dt (int64 ps) and clock floors
-    icount_ev = jnp.maximum(arg2, 0).astype(jnp.int64)
+    # (arg2 low 20 bits: COMPUTE icount; high bits carry register
+    # annotations — see the complex slot's scoreboard)
+    icount_ev = jnp.maximum(arg2 & ((1 << 20) - 1), 0).astype(jnp.int64)
     n_lines = jnp.maximum(
         (icount_ev * ICACHE_BYTES_PER_INSTRUCTION + params.line_size - 1)
         // params.line_size, 1)
@@ -390,14 +406,17 @@ def _block_retire(params: SimParams, st: SimState,
     # tile (ThreadManager::spawnThread path; a chain of spawns — how every
     # trace launches its tiles — retires K per round here instead of one
     # per general slot).
-    child = jnp.clip(arg2, 0, T - 1)
+    # ``child`` is a STREAM id; its tile is the scheduler's static
+    # round-robin placement (child % T; identity when streams == tiles).
+    S_ids = st.spawned_at.shape[0]
+    child = jnp.clip(arg2, 0, S_ids - 1)
     spawn_base = jnp.maximum(clk_before, floor) if iocoom else clk_before
     spawn_land = spawn_base + dt_spawn + noc.unicast_ps(
-        params.net_user, jnp.broadcast_to(rows[:, None], (T, K)), child,
-        8, _period(st, DVFSModule.NETWORK_USER)[:, None],
+        params.net_user, jnp.broadcast_to(rows[:, None], (T, K)),
+        child % T, 8, _period(st, DVFSModule.NETWORK_USER)[:, None],
         params.mesh_width)
     spawned_at = st.spawned_at.at[
-        jnp.where(is_spawn & retired, child, T)].max(
+        jnp.where(is_spawn & retired, child, S_ids)].max(
         spawn_land, mode="drop")
 
     # ---- apply cache effects (stamps encode within-window order)
@@ -529,7 +548,7 @@ def _block_retire(params: SimParams, st: SimState,
 
     c = c._replace(
         icount=c.icount + msum(is_comp, icount_ev)
-        + msum((is_mem & (arg2 == 0)) | is_br),
+        + msum((is_mem & ((arg2 & 0xFF) == 0)) | is_br),
         l1i_access=c.l1i_access + msum(is_comp, icount_ev) + msum(is_br),
         l1i_miss=c.l1i_miss + msum(is_comp & ~pI.hit, n_lines),
         l1d_read=c.l1d_read + msum(is_rd),
@@ -623,8 +642,9 @@ def _complex_slot(params: SimParams, state: SimState,
         # chain elements waits for the resolve pass to drain them.
         active = active & (st.mq_count == 0)
     cur = jnp.minimum(st.cursor, N - 1)
-    ev = trace.meta[:, rows, cur]          # [3, T] one fused gather
-    addr = trace.addr[rows, cur]
+    srow = st.seat_stream if st.sched_enabled else rows
+    ev = trace.meta[:, srow, cur]          # [3, T] one fused gather
+    addr = trace.addr[srow, cur]
     op = jnp.where(active, ev[0], EventOp.NOP)
     arg = ev[1]
     arg2 = ev[2]
@@ -670,6 +690,14 @@ def _complex_slot(params: SimParams, state: SimState,
             drain_op = drain_op | (op == EventOp.BRANCH)
         clk = jnp.where(drain_op, jnp.maximum(st.clock, drain_t),
                         st.clock)
+        # Register scoreboard RAW floor (reference
+        # iocoom_core_model.cc:119-143: read-register operands delay the
+        # instruction to their ready times): a COMPUTE event naming a
+        # source register stalls until that register's ready time.
+        sreg = (arg2 >> 20) & 31          # src reg + 1, 0 = none
+        has_sreg = (op == EventOp.COMPUTE) & (sreg > 0)
+        rr = st.reg_ready[jnp.maximum(sreg - 1, 0), rows]
+        clk = jnp.where(has_sreg, jnp.maximum(clk, rr), clk)
     else:
         clk = st.clock
 
@@ -699,7 +727,9 @@ def _complex_slot(params: SimParams, state: SimState,
 
     # ---------------------------------------------------- COMPUTE blocks
     is_comp = op == EventOp.COMPUTE
-    icount_ev = jnp.maximum(arg2, 0).astype(jnp.int64)
+    # COMPUTE arg2 packs (icount | src_reg+1 << 20 | dst_reg+1 << 25) —
+    # events/schema.py register annotations for the iocoom scoreboard.
+    icount_ev = jnp.maximum(arg2 & ((1 << 20) - 1), 0).astype(jnp.int64)
     n_lines = jnp.maximum(
         (icount_ev * ICACHE_BYTES_PER_INSTRUCTION + params.line_size - 1)
         // params.line_size, 1)
@@ -776,15 +806,33 @@ def _complex_slot(params: SimParams, state: SimState,
         ch_full = (sent_row - recvd_row) >= chan_depth
         is_send = is_send_op & ~ch_full
         send_block = is_send_op & ch_full
-        send_net_ps = noc.unicast_ps(
-            params.net_user, rows, dst, jnp.maximum(arg, 0), p_nu,
-            params.mesh_width)
         slot_idx = sent_row % chan_depth
         # The reused ring slot holds the consuming recv's completion time
         # (written by resolve_recv): even when the count check shows space,
         # the message can't occupy the slot before the recv that freed it.
         slot_freed = st.ch_time[slot_idx, rows, dst]
-        arrival = jnp.maximum(clk + cycle_ps, slot_freed) + send_net_ps
+        depart = jnp.maximum(clk + cycle_ps, slot_freed)
+        if params.net_user.model == "emesh_hop_by_hop":
+            # CAPI data packets contend per link on the user mesh
+            # (reference: the USER network's own hop-by-hop model +
+            # queue models, network_model_emesh_hop_by_hop.cc).
+            from graphite_tpu.engine import noc_flight
+            fl = noc_flight.flight(
+                params.net_user, params.mesh_width, params.mesh_height,
+                rows.astype(jnp.int32), dst, depart,
+                noc.num_flits(jnp.maximum(arg, 0),
+                              params.net_user.flit_width_bits),
+                is_send & active, st.link_free_user, p_nu)
+            st = st._replace(link_free_user=fl.link_free)
+            c = c._replace(net_link_wait_ps=c.net_link_wait_ps
+                           + jnp.where(is_send & active & en,
+                                       fl.wait_ps, 0))
+            arrival = jnp.where(is_send, fl.arrival, depart)
+        else:
+            send_net_ps = noc.unicast_ps(
+                params.net_user, rows, dst, jnp.maximum(arg, 0), p_nu,
+                params.mesh_width)
+            arrival = depart + send_net_ps
         rows_send = jnp.where(is_send, rows, T).astype(jnp.int32)
         ch_time = st.ch_time.at[slot_idx, rows_send, dst].set(
             arrival, mode="drop")
@@ -835,19 +883,28 @@ def _complex_slot(params: SimParams, state: SimState,
 
     # spawn: start the child's stream once the spawn request lands on
     # its tile (ThreadManager::spawnThread -> masterSpawnThread path).
+    # ``child`` is a STREAM id; placement is child % T (scheduler's
+    # static round-robin; identity when streams == tiles).
     is_spawn = op == EventOp.SPAWN
-    child = jnp.clip(arg2, 0, T - 1)
+    S_ids = st.spawned_at.shape[0]
+    child = jnp.clip(arg2, 0, S_ids - 1)
     spawn_land = clk + _lat(jnp.maximum(arg, 0), p_core) \
-        + noc.unicast_ps(params.net_user, rows, child, 8, p_nu,
+        + noc.unicast_ps(params.net_user, rows, child % T, 8, p_nu,
                          params.mesh_width)
     spawned_at = st.spawned_at.at[
-        jnp.where(is_spawn, child, T)].max(spawn_land, mode="drop")
+        jnp.where(is_spawn, child, S_ids)].max(spawn_land, mode="drop")
 
     # ------------------------------------------------ SIMPLE/DYNAMIC OPS
     is_stall = op == EventOp.STALL
     is_sync = op == EventOp.SYNC
     is_dvfs = op == EventOp.DVFS_SET
     is_done = op == EventOp.DONE
+    # YIELD: MCP round trip to the ThreadScheduler
+    # (ThreadScheduler::yieldThread netSends a request and waits for the
+    # reply, thread_scheduler.cc:645-668); the rotation itself happens at
+    # the next quantum boundary (schedule_rotate).  With one stream per
+    # tile there is nothing to rotate to and the event is cost-only.
+    is_yield = op == EventOp.YIELD
     dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
     dt_dvfs = _lat(params.dvfs_sync_delay_cycles, p_core)
 
@@ -886,6 +943,7 @@ def _complex_slot(params: SimParams, state: SimState,
     # ROI-gated like compute/memory: with models off a syscall still
     # executes functionally but charges no simulated time.
     dt = jnp.where(is_sysc & en, dt_sysc, dt)
+    dt = jnp.where(is_yield & en, 2 * to_mcp_ps + cycle_ps, dt)
 
     new_clock = clk + dt
     new_clock = jnp.where(
@@ -939,9 +997,14 @@ def _complex_slot(params: SimParams, state: SimState,
     pend_issue = jnp.where(blocked, issue, st.pend_issue)
     # For memory requests pend_aux carries the atomic flag (resolve
     # needs it: iocoom lets plain loads/stores complete out-of-order
-    # but atomics wait their full round trip).
+    # but atomics wait their full round trip) plus, for scoreboarded
+    # loads, the destination register + 1 in bits 8-12 (resolve lands
+    # the unpark time there — reference executeLoad feeding
+    # _register_scoreboard via write_operands_ready).
+    mdreg = jnp.where(is_rd, (arg2 >> 8) & 31, 0)   # dest reg + 1
     pend_aux = jnp.where(blocked,
-                         jnp.where(mem_rem, is_at.astype(jnp.int32),
+                         jnp.where(mem_rem,
+                                   is_at.astype(jnp.int32) | (mdreg << 8),
                                    arg2),
                          st.pend_aux)
     # Local cost still owed once the remote part resolves: a blocked
@@ -1053,7 +1116,7 @@ def _complex_slot(params: SimParams, state: SimState,
     c = c._replace(
         icount=c.icount
         + jnp.where(is_comp & en, icount_ev, 0)
-        + jnp.where(((is_mem & (arg2 == 0)) | is_br) & en, 1, 0),
+        + jnp.where(((is_mem & ((arg2 & 0xFF) == 0)) | is_br) & en, 1, 0),
         l1i_access=c.l1i_access + jnp.where(is_comp & en, icount_ev, 0)
         + jnp.where(is_br & en, 1, 0),
         l1i_miss=c.l1i_miss + jnp.where(is_comp & ~pI.hit & active & en,
@@ -1084,11 +1147,32 @@ def _complex_slot(params: SimParams, state: SimState,
         syscall_ps=c.syscall_ps + jnp.where(is_sysc & en, dt_sysc, 0),
     )
 
+    if st.sched_enabled:
+        done_at = st.done_at.at[
+            jnp.where(is_done, srow, S_ids)].set(clk, mode="drop")
+        st = st._replace(seat_yield=st.seat_yield | is_yield)
+    else:
+        done_at = jnp.where(is_done, clk, st.done_at)
+
+    # Scoreboard writes (iocoom): a register-writing COMPUTE lands its
+    # completion; a HITTING load lands the load completion (missing
+    # loads land via resolve, carried in pend_aux bits 8-12).  Reference:
+    # iocoom_core_model.cc:188-199 (_register_scoreboard[reg] =
+    # write_operands_ready).
+    if params.core.model == "iocoom":
+        NREG = st.reg_ready.shape[0]
+        dregc = (arg2 >> 25) & 31
+        wreg = jnp.where(is_comp & (dregc > 0), dregc,
+                         jnp.where((mem_l1 | mem_l2) & is_rd
+                                   & (mdreg > 0), mdreg, 0))
+        st = st._replace(reg_ready=st.reg_ready.at[
+            jnp.where((wreg > 0) & active, wreg - 1, NREG),
+            rows].max(new_clock, mode="drop"))
     st = st._replace(
         clock=new_clock,
         cursor=st.cursor + jnp.where(active & ~blocked, 1, 0),
         done=st.done | is_done,
-        done_at=jnp.where(is_done, clk, st.done_at),
+        done_at=done_at,
         spawned_at=spawned_at,
         models_enabled=models_enabled,
         pend_kind=pend_kind,

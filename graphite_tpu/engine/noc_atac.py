@@ -50,16 +50,14 @@ def geometry(a: AtacParams):
     cx, cy = x // a.cluster_width, y // a.cluster_height
     cluster_of = cy * a.numx_clusters + cx
 
-    # Sub-cluster factorization (initializeClusters: even log2 -> square,
-    # odd -> 2:1 in x) over num_access_points sub-clusters per cluster.
+    # Sub-cluster factorization over num_access_points sub-clusters per
+    # cluster (shared pow2_grid helper; the sub-cluster grid puts the
+    # long side on X — network_model_atac.cc:620-630).
+    from graphite_tpu.params import pow2_grid
     nsub = max(1, min(a.num_access_points, a.cluster_size))
-    lg = nsub.bit_length() - 1
-    if nsub != 1 << lg:          # non-power-of-two: fall back to 1 AP
-        nsub, lg = 1, 0
-    if lg % 2 == 0:
-        sx = sy = 1 << (lg // 2)
-    else:
-        sx, sy = 1 << ((lg + 1) // 2), 1 << ((lg - 1) // 2)
+    if nsub != 1 << (nsub.bit_length() - 1):
+        nsub = 1                 # non-power-of-two: fall back to 1 AP
+    sx, sy = pow2_grid(nsub, tall=False)
     sub_w = max(1, a.cluster_width // sx)
     sub_h = max(1, a.cluster_height // sy)
     # Access point of each tile's sub-cluster, at the sub-cluster center.
@@ -84,10 +82,8 @@ def geometry(a: AtacParams):
 
 def _enet_cycles(a: AtacParams, net: NetworkParams, src, dst):
     """XY hop cycles on the electrical mesh (routePacketOnENet)."""
-    W = a.enet_width
-    sx, sy = src % W, src // W
-    dx, dy = dst % W, dst // W
-    hops = jnp.abs(sx - dx) + jnp.abs(sy - dy)
+    from graphite_tpu.engine import noc
+    hops = noc.hop_count(src, dst, a.enet_width)
     return hops * (net.router_delay_cycles + net.link_delay_cycles)
 
 
@@ -120,9 +116,8 @@ def unicast_cycles(net: NetworkParams, src, dst):
     onet = _onet_cycles(a, net, src)
     same = cluster_of[src] == cluster_of[dst]
     if a.global_routing_strategy == "distance_based":
-        W = a.enet_width
-        hops = (jnp.abs(src % W - dst % W)
-                + jnp.abs(src // W - dst // W))
+        from graphite_tpu.engine import noc
+        hops = noc.hop_count(src, dst, a.enet_width)
         use_enet = same | (hops <= a.unicast_distance_threshold)
     else:
         use_enet = same

@@ -33,8 +33,9 @@ import jax.numpy as jnp
 from graphite_tpu.engine.core import local_advance
 from graphite_tpu.engine.resolve import resolve
 from graphite_tpu.engine.state import (
-    PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_JOIN, PEND_MUTEX,
-    PEND_RECV, PEND_SEND, PEND_START, SimState, TraceArrays)
+    PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
+    PEND_JOIN, PEND_MUTEX, PEND_RECV, PEND_SEND, PEND_SH_REQ, PEND_START,
+    SimState, TraceArrays)
 from graphite_tpu.params import SimParams
 from graphite_tpu.time_base import TIME_MAX
 
@@ -87,7 +88,15 @@ def _maybe_sample(params: SimParams, state: SimState) -> SimState:
             jnp.sum(c.icount), jnp.sum(c.net_mem_flits),
             jnp.sum(c.net_user_flits), jnp.sum(c.dram_reads),
             jnp.sum(c.dram_writes), live, repl,
-            jnp.sum(c.net_link_wait_ps)])
+            jnp.sum(c.net_link_wait_ps),
+            # Energy-bearing counters for the power trace
+            # ([runtime_energy_modeling/power_trace]; energy.power_trace
+            # diffs consecutive samples into per-interval watts).
+            jnp.sum(c.l1i_access),
+            jnp.sum(c.l1d_read) + jnp.sum(c.l1d_write),
+            jnp.sum(c.l2_access), jnp.sum(c.branches),
+            jnp.sum(c.dir_sh_req) + jnp.sum(c.dir_ex_req)
+            + jnp.sum(c.dir_invalidations)])
         st = st._replace(
             stat_time=st.stat_time.at[idx].set(st.boundary),
             stat_scalars=st.stat_scalars.at[:, idx].set(scalars),
@@ -103,6 +112,107 @@ def _maybe_sample(params: SimParams, state: SimState) -> SimState:
     return jax.lax.cond(do, take, lambda st: st, state)
 
 
+def schedule_rotate(params: SimParams, state: SimState) -> SimState:
+    """ThreadScheduler seat rotation (reference: thread_scheduler.h:30-56,
+    round_robin_thread_scheduler.cc; yield path thread_scheduler.cc:615-660).
+
+    Streams are placed round-robin (strm_tile = s % num_tiles — the
+    reference's default placement for uniform spawns; affinity/migration
+    are not implemented and rejected nowhere since no event emits them).
+    Each tile SEATS one stream; the engine's [T] context arrays are the
+    seats.  A seat rotates to the tile's lowest-strm_key waiting stream
+    when the seated stream (a) is done, (b) retired a YIELD, (c) parked
+    on THREAD_START unspawned, or (d) held the seat past the preemption
+    quantum — measured in simulated time (the reference uses host
+    seconds, thread_scheduler.cc:632-636).  (d) also rotates streams
+    parked on sync objects, so a lock holder queued behind its waiter
+    eventually runs (round-robin => no starvation); a rotated-out park
+    freezes until the stream is reseated, which skews sync wakeups by at
+    most the rotation period — the scheduler's own artifact in the
+    reference too.  Memory parks (SH/EX/IFETCH) never rotate: resolve
+    serves them within a few rounds.
+    """
+    T = params.num_tiles
+    S = state.strm_cursor.shape[0]
+    sst = state.seat_stream                               # [T]
+    tiles = jnp.arange(T, dtype=jnp.int32)
+    strm_tile = (jnp.arange(S, dtype=jnp.int32) % T)      # static placement
+
+    # Sync the stream store's bookkeeping for seated streams.
+    strm_done = state.strm_done.at[sst].set(state.done)
+    state = state._replace(strm_done=strm_done)
+
+    k = state.pend_kind
+    # Tiles mid-memory-transaction never rotate: parked requests
+    # (SH/EX/IFETCH) resolve within a few rounds, and a non-empty miss
+    # chain (mq_count > 0, tpu/miss_chain > 0) is tile-resident bank
+    # state belonging to the seated stream — rotating under it would
+    # drain the old stream's banked requests against the new stream's
+    # clock.
+    mem_park = ((k == PEND_SH_REQ) | (k == PEND_EX_REQ)
+                | (k == PEND_IFETCH)) | (state.mq_count > 0)
+    unspawned_gate = (k == PEND_START) \
+        & (state.spawned_at[sst] < 0)
+    expired = (state.boundary - state.seat_since) \
+        >= jnp.int64(params.thread_switch_quantum_ps)
+    give_up = (state.done | state.seat_yield | unspawned_gate
+               | expired) & ~mem_park
+
+    # Waiting streams per tile (not seated, not done), FCFS by strm_key.
+    seated = jnp.zeros(S, dtype=bool).at[sst].set(True)
+    waiting = ~seated & ~strm_done
+    BIG = jnp.int64(2**62)
+    tbl = jnp.full((T,), BIG, jnp.int64).at[
+        jnp.where(waiting, strm_tile, T)].min(state.strm_key, mode="drop")
+    has_wait = tbl < BIG
+    rotate = give_up & has_wait                           # [T]
+    winner = waiting & (tbl[strm_tile] == state.strm_key) \
+        & rotate[strm_tile]                               # [S]
+    in_s = jnp.zeros(T, dtype=jnp.int32).at[
+        jnp.where(winner, strm_tile, T)].max(
+        jnp.arange(S, dtype=jnp.int32), mode="drop")      # [T]
+
+    # Save the outgoing context into the store (rotating tiles only).
+    out_s = jnp.where(rotate, sst, S)
+    def save(store, seat_val):
+        return store.at[out_s].set(seat_val, mode="drop")
+    max_key = jnp.max(state.strm_key)
+    state = state._replace(
+        strm_cursor=save(state.strm_cursor, state.cursor),
+        strm_clock=save(state.strm_clock, state.clock),
+        strm_pend_kind=save(state.strm_pend_kind, state.pend_kind),
+        strm_pend_addr=save(state.strm_pend_addr, state.pend_addr),
+        strm_pend_issue=save(state.strm_pend_issue, state.pend_issue),
+        strm_pend_aux=save(state.strm_pend_aux, state.pend_aux),
+        strm_pend_extra=save(state.strm_pend_extra, state.pend_extra),
+        strm_done=state.strm_done.at[out_s].set(state.done, mode="drop"),
+        # Outgoing stream goes to the back of the queue: keys stay unique
+        # because each rotating tile adds a distinct offset.
+        strm_key=state.strm_key.at[out_s].set(
+            max_key + 1 + tiles.astype(jnp.int64), mode="drop"),
+    )
+    # Load the incoming context; the core is serial, so the incoming
+    # stream's clock can never precede the outgoing one's.
+    def load(seat_val, store):
+        return jnp.where(rotate, store[in_s], seat_val)
+    state = state._replace(
+        cursor=load(state.cursor, state.strm_cursor),
+        clock=jnp.where(rotate,
+                        jnp.maximum(state.strm_clock[in_s], state.clock),
+                        state.clock),
+        done=load(state.done, state.strm_done),
+        pend_kind=load(state.pend_kind, state.strm_pend_kind),
+        pend_addr=load(state.pend_addr, state.strm_pend_addr),
+        pend_issue=load(state.pend_issue, state.strm_pend_issue),
+        pend_aux=load(state.pend_aux, state.strm_pend_aux),
+        pend_extra=load(state.pend_extra, state.strm_pend_extra),
+        seat_stream=jnp.where(rotate, in_s, sst),
+        seat_since=jnp.where(rotate, state.boundary, state.seat_since),
+        seat_yield=jnp.where(rotate, False, state.seat_yield),
+    )
+    return state
+
+
 def quantum_step(params: SimParams, state: SimState,
                  trace: TraceArrays) -> SimState:
     """One barrier quantum: all tiles advance to the new boundary.
@@ -113,6 +223,8 @@ def quantum_step(params: SimParams, state: SimState,
     sub-round (most of them) pay for one instead of the full cap."""
     state = state._replace(boundary=next_boundary(params, state),
                            ctr_quantum=state.ctr_quantum + 1)
+    if state.sched_enabled:
+        state = schedule_rotate(params, state)
 
     def progress(st):
         # cursor moves on any retire/bank/unblock; clock moves when a
@@ -133,7 +245,8 @@ def quantum_step(params: SimParams, state: SimState,
 
     _, _, state = jax.lax.while_loop(
         cond, body, (jnp.int32(0), jnp.int64(-1), state))
-    if params.stats_enabled or params.progress_enabled:
+    if params.stats_enabled or params.progress_enabled \
+            or params.power_trace_enabled:
         state = _maybe_sample(params, state)
     return state
 

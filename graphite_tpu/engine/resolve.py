@@ -1439,10 +1439,19 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         kcnt_inv = jnp.sum(inv_bool, axis=1).astype(jnp.int64)  # [K]
         if bcast_traffic is not None:
             # Broadcast schemes put T-1 INV packets on the wire for an
-            # overflowed entry regardless of the true sharer count.
+            # overflowed entry regardless of the true sharer count —
+            # unless the mesh forks broadcasts down a tree, where the
+            # source injects ONE packet and the routers replicate it
+            # (reference: [network/emesh_hop_by_hop]
+            # broadcast_tree_enabled, carbon_sim.cfg:299-313;
+            # network.cc:215- falls back to sender-side fan-out when the
+            # model lacks native broadcast).  Latency is the max-hop
+            # bound either way (tree depth == farthest destination).
             bt_k = jnp.any(oh_sr & (bcast_traffic & has_inv)[None, :],
                            axis=1)
-            kcnt_inv = jnp.where(bt_k, T - 1, kcnt_inv)
+            bcast_pkts = 1 if params.net_memory.broadcast_tree_enabled \
+                else T - 1
+            kcnt_inv = jnp.where(bt_k, bcast_pkts, kcnt_inv)
         kcnt = kcnt_inv + jnp.sum(vic_bool, axis=1).astype(jnp.int64)
         inv_count = jnp.sum(jnp.where(oh_sr, kcnt[:, None], 0), axis=0)
         c = state.counters
@@ -1519,7 +1528,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # i-fetches always wait in full.  (Reference:
         # iocoom_core_model.cc:78- load queue / store buffer.)
         if params.core.model == "iocoom":
-            is_atomic = aux != 0
+            # aux bit 0 = atomic flag; bits 8-12 = scoreboard dest
+            # register + 1 (core.py pend_aux packing).
+            is_atomic = (aux & 0xFF) != 0
             is_load = win & (kind == PEND_SH_REQ) & ~is_atomic
             is_store = win & (kind == PEND_EX_REQ) & ~is_atomic
             LQE = state.lq_ready.shape[0]
@@ -1547,6 +1558,15 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                                    state.sq_ready),
                 lq_next=state.lq_next + is_load,
                 sq_next=state.sq_next + is_store)
+            # Scoreboarded remote load: land the priced completion in the
+            # destination register's ready slot (reference executeLoad ->
+            # _register_scoreboard[reg] = write_operands_ready,
+            # iocoom_core_model.cc:188-199).
+            NREG = state.reg_ready.shape[0]
+            dreg = (aux >> 8) & 31
+            state = state._replace(reg_ready=state.reg_ready.at[
+                jnp.where(is_load & (dreg > 0), dreg - 1, NREG),
+                jnp.arange(T)].max(completion, mode="drop"))
         else:
             unpark = completion
 
@@ -2036,8 +2056,16 @@ def resolve_join(params: SimParams, state: SimState) -> SimState:
     T = params.num_tiles
     rows = jnp.arange(T)
     is_j = state.pend_kind == PEND_JOIN
-    child = jnp.clip(state.pend_aux, 0, T - 1)
-    child_done = state.done[child]
+    # ``child`` is a STREAM id ([S] done_at; == tile when the scheduler
+    # is off).  A seated child's done flag lives in the seat, not the
+    # store — merge before the lookup.
+    S_ids = state.done_at.shape[0]
+    child = jnp.clip(state.pend_aux, 0, S_ids - 1)
+    if state.sched_enabled:
+        sdone = state.strm_done.at[state.seat_stream].set(state.done)
+        child_done = sdone[child]
+    else:
+        child_done = state.done[child]
     child_done_at = state.done_at[child]
     ok = is_j & child_done
     p_nu = _period(state, DVFSModule.NETWORK_USER)
@@ -2047,7 +2075,7 @@ def resolve_join(params: SimParams, state: SimState) -> SimState:
                             CTRL_BYTES, p_nu, params.mesh_width)
     from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
                               CTRL_BYTES, p_nu[mcp], params.mesh_width)
-    exit_at_mcp = child_done_at + to_mcp[child]
+    exit_at_mcp = child_done_at + to_mcp[child % T]
     completion = jnp.maximum(state.pend_issue + to_mcp, exit_at_mcp) \
         + from_mcp + cycle_ps
     state = state._replace(counters=state.counters._replace(
@@ -2057,11 +2085,16 @@ def resolve_join(params: SimParams, state: SimState) -> SimState:
 
 
 def resolve_start(params: SimParams, state: SimState) -> SimState:
-    """Release THREAD_START gates whose tile has been SPAWNed."""
+    """Release THREAD_START gates whose stream has been SPAWNed
+    (spawned_at is stream-indexed; the seat's stream id maps it)."""
     is_s = state.pend_kind == PEND_START
-    ok = is_s & (state.spawned_at >= 0)
+    if state.sched_enabled:
+        seat_spawned = state.spawned_at[state.seat_stream]
+    else:
+        seat_spawned = state.spawned_at
+    ok = is_s & (seat_spawned >= 0)
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
-    completion = jnp.maximum(state.pend_issue, state.spawned_at) + cycle_ps
+    completion = jnp.maximum(state.pend_issue, seat_spawned) + cycle_ps
     return _unblock(state, ok, completion, sync=True)
 
 

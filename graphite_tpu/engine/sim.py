@@ -36,7 +36,10 @@ class SimSummary:
         self.host_seconds = host_seconds
         self.steps = steps
         self.clock = np.asarray(state.clock)
-        self.done = np.asarray(state.done)
+        # Per-STREAM done (== per-tile when the scheduler is off): a
+        # seat only shows its currently-scheduled stream.
+        self.done = np.asarray(state.all_done()).reshape(1) \
+            if state.sched_enabled else np.asarray(state.done)
         self.period_ps = np.asarray(state.period_ps)
         self.stat_filled = int(state.stat_filled)
         self.stat_time = np.asarray(state.stat_time)
@@ -66,6 +69,22 @@ class SimSummary:
     STAT_SERIES = ("icount", "net_mem_flits", "net_user_flits",
                    "dram_reads", "dram_writes", "live_l2_lines",
                    "sharer_copies", "net_link_wait_ps")
+
+    def power_trace(self) -> Dict[str, np.ndarray]:
+        """Per-interval power from the sampled energy counters — the
+        reference's [runtime_energy_modeling/power_trace] file
+        (carbon_sim.cfg:141-145, TileEnergyMonitor)."""
+        from graphite_tpu.energy import power_trace
+        return power_trace(self.params, self.stat_time, self.stat_scalars,
+                           self.stat_filled)
+
+    def write_power_trace(self, path: str) -> None:
+        pt = self.power_trace()
+        with open(path, "w") as f:
+            f.write("time_ns,dynamic_w,leakage_w,total_w\n")
+            for i in range(len(pt["time_ns"])):
+                f.write(f"{pt['time_ns'][i]:.1f},{pt['dynamic_w'][i]:.6f},"
+                        f"{pt['leakage_w'][i]:.6f},{pt['total_w'][i]:.6f}\n")
 
     def stats_trace(self) -> Dict[str, np.ndarray]:
         """Periodic samples taken at quantum boundaries (the reference's
@@ -222,10 +241,14 @@ class Simulator:
     unit tests, tests/unit/shared_mem_basic/Makefile:6)."""
 
     def __init__(self, params: SimParams, trace: Trace):
-        if trace.num_tiles != params.num_tiles:
+        # More trace streams than tiles engages the ThreadScheduler
+        # (round-robin multi-thread-per-core, reference
+        # thread_scheduler.h:30-56); fewer is an error, as is exceeding
+        # tiles x general/max_threads_per_core (checked in make_state).
+        if trace.num_tiles < params.num_tiles:
             raise ValueError(
-                f"trace has {trace.num_tiles} tiles, params expect "
-                f"{params.num_tiles}")
+                f"trace has {trace.num_tiles} streams, params expect "
+                f"at least {params.num_tiles}")
         self.params = params
         self.trace = TraceArrays.from_trace(trace)
         # CAPI channel state is O(T^2); only allocate it when the trace
@@ -234,7 +257,12 @@ class Simulator:
         ops = np.asarray(trace.ops)
         has_capi = bool(((ops == int(EventOp.SEND))
                          | (ops == int(EventOp.RECV))).any())
-        self.state = make_state(params, has_capi=has_capi)
+        if has_capi and trace.num_tiles > params.num_tiles:
+            raise ValueError(
+                "CAPI SEND/RECV with multi-thread-per-core scheduling is "
+                "not supported yet (channel state is tile-addressed)")
+        self.state = make_state(params, has_capi=has_capi,
+                                num_streams=trace.num_tiles)
         self.steps = 0
         self.host_seconds = 0.0
 
@@ -255,7 +283,7 @@ class Simulator:
                 if max_steps is not None and self.steps >= max_steps:
                     break
             done, cursor_sum, clock_sum = jax.device_get(
-                (self.state.done.all(), self.state.cursor.sum(),
+                (self.state.all_done(), self.state.cursor.sum(),
                  self.state.clock.sum()))
             if bool(done):
                 break

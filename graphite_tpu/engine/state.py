@@ -299,6 +299,12 @@ class SimState(NamedTuple):
     sq_ready: jnp.ndarray      # [SQE, T] int64
     lq_next: jnp.ndarray       # [T] int32 ring cursor
     sq_next: jnp.ndarray       # [T] int32
+    # Register scoreboard (iocoom; reference iocoom_core_model.h:82,
+    # .cc:119-136): per-register ready times.  Trace events carry
+    # compressed 5-bit register annotations (events/schema.py
+    # NUM_REGISTERS); reads floor the instruction's issue, writes land
+    # completion times.  [0, T] when the core model is 'simple'.
+    reg_ready: jnp.ndarray     # [NREG, T] int64
 
     # -- memory controllers (reference: dram_cntlr.h + dram_perf_model.h;
     # queueing per queue_model_history_list.cc — a bounded ring of busy
@@ -318,6 +324,11 @@ class SimState(NamedTuple):
     # -- mesh link horizons (emesh_hop_by_hop contention; reference:
     # per-link queue models in network_model_emesh_hop_by_hop.cc)
     link_free_mem: jnp.ndarray  # [NUM_DIRS, T] int64 directed-link horizons
+    # User-network link horizons (CAPI data traffic under
+    # network/user = emesh_hop_by_hop; [NUM_DIRS, 0] otherwise).  MCP
+    # control trips stay zero-load: the reference routes those over the
+    # SYSTEM network, which has its own (magic by default) model.
+    link_free_user: jnp.ndarray
 
     # -- sync objects, global (reference: sync_server.h SimMutex/SimBarrier/
     # SimCond)
@@ -329,10 +340,34 @@ class SimState(NamedTuple):
     # entries — pend_addr = cond id, pend_issue = MCP arrival — so no
     # dedicated arrays are needed and every token keeps its exact time)
 
-    # -- thread lifecycle (reference: thread_manager.cc spawn/join tables)
-    spawned_at: jnp.ndarray    # [T] int64 when this tile's stream was
-    #   spawned (-1 = not yet; THREAD_START gates on it)
-    done_at: jnp.ndarray       # [T] int64 when the tile's DONE retired
+    # -- thread lifecycle (reference: thread_manager.cc spawn/join tables).
+    # STREAM-indexed ([S] where S = trace streams; S == T unless the
+    # ThreadScheduler multiplexes several streams per tile).
+    spawned_at: jnp.ndarray    # [S] int64 when this stream was spawned
+    #   (-1 = not yet; THREAD_START gates on it)
+    done_at: jnp.ndarray       # [S] int64 when the stream's DONE retired
+
+    # -- ThreadScheduler seats (reference: thread_scheduler.h:30-56 +
+    # round_robin_thread_scheduler.cc).  The engine's [T] context arrays
+    # (clock/cursor/pend_*/done above) are SEATS — the running stream of
+    # each tile; descheduled streams live in the strm_* store and rotate
+    # in round-robin at quantum boundaries (engine/quantum.py
+    # schedule_rotate).  All [0]-shaped when S == T (scheduler compiled
+    # out; streams pin 1:1 to tiles exactly as before).
+    seat_stream: jnp.ndarray   # [T] int32 stream seated on each tile
+    seat_since: jnp.ndarray    # [T] int64 sim time the seat last rotated
+    seat_yield: jnp.ndarray    # [T] bool YIELD retired since last rotate
+    strm_cursor: jnp.ndarray   # [S] int32 (valid iff not seated)
+    strm_clock: jnp.ndarray    # [S] int64
+    strm_pend_kind: jnp.ndarray   # [S] int32
+    strm_pend_addr: jnp.ndarray   # [S] int64
+    strm_pend_issue: jnp.ndarray  # [S] int64
+    strm_pend_aux: jnp.ndarray    # [S] int32
+    strm_pend_extra: jnp.ndarray  # [S] int64
+    strm_done: jnp.ndarray     # [S] bool (kept in sync for seated streams
+    #   at every rotation; authoritative for completion)
+    strm_key: jnp.ndarray      # [S] int64 round-robin queue key (unique;
+    #   lowest key among a tile's waiting streams is seated next)
 
     # -- region of interest (reference: Simulator::enableModels +
     # PerformanceCounterManager broadcast) — one global flag; outside the
@@ -345,7 +380,7 @@ class SimState(NamedTuple):
     stat_filled: jnp.ndarray      # [] int32 samples taken
     stat_next: jnp.ndarray        # [] int64 next sample time
     stat_time: jnp.ndarray        # [S] int64 sample timestamps
-    stat_scalars: jnp.ndarray     # [8, S] int64 aggregate series:
+    stat_scalars: jnp.ndarray     # [13, S] int64 aggregate series:
     #   (icount, net_mem_flits, net_user_flits, dram_reads, dram_writes,
     #    live_l2_or_slice_lines, sharer_bits [replication], link_wait_ps)
     stat_icount: jnp.ndarray      # [S, T] int64 per-tile icount snapshots
@@ -388,6 +423,27 @@ class SimState(NamedTuple):
     def has_capi(self) -> bool:
         """Static: were CAPI channel arrays allocated for this run?"""
         return self.ch_sent.size > 0
+
+    @property
+    def sched_enabled(self) -> bool:
+        """Static: is the ThreadScheduler active (more streams than
+        tiles)?"""
+        return self.seat_stream.size > 0
+
+    @property
+    def num_streams(self) -> int:
+        """Static: app-thread streams (== tiles unless the scheduler
+        multiplexes)."""
+        return self.strm_cursor.shape[0] if self.sched_enabled \
+            else self.clock.shape[0]
+
+    def all_done(self) -> jnp.ndarray:
+        """Scalar bool: every STREAM is done (seats only cover the
+        currently-scheduled subset when the scheduler is on)."""
+        if self.sched_enabled:
+            return jnp.all(self.strm_done.at[self.seat_stream]
+                           .set(self.done))
+        return self.done.all()
 
     # Unpacked directory views (tests/tools; the engine reads dir_word).
     @property
@@ -439,15 +495,29 @@ MISS_FILTER_SLOTS = 1 << 14   # per-tile miss-type filter entries (2x the
 def _nsamp(params: SimParams) -> int:
     """Sample-ring capacity: 1-row dummy when no sampling is configured."""
     return params.max_stat_samples \
-        if (params.stats_enabled or params.progress_enabled) else 1
+        if (params.stats_enabled or params.progress_enabled
+            or params.power_trace_enabled) else 1
 
 
 def make_state(params: SimParams,
                max_mutexes: int = 64,
                max_barriers: int = 16,
                channel_depth: int = 0,
-               has_capi: bool = True) -> SimState:
+               has_capi: bool = True,
+               num_streams: int = 0) -> SimState:
     T = params.num_tiles
+    S = num_streams if num_streams > 0 else T
+    if S < T:
+        raise ValueError(
+            f"trace has {S} streams but params expect {T} tiles; "
+            f"fewer streams than tiles is not supported")
+    if S > T * params.max_threads_per_core:
+        raise ValueError(
+            f"trace has {S} streams > {T} tiles x "
+            f"{params.max_threads_per_core} general/max_threads_per_core "
+            f"(the reference refuses the same overflow, "
+            f"thread_scheduler.cc:577)")
+    sched = S > T
     if T > (1 << _DIR_OWNER_BITS) - 2:
         raise ValueError(
             f"num_tiles {T} exceeds the packed directory owner field "
@@ -491,22 +561,44 @@ def make_state(params: SimParams,
                            dtype=jnp.int64),
         lq_next=jnp.zeros(T, dtype=jnp.int32),
         sq_next=jnp.zeros(T, dtype=jnp.int32),
+        reg_ready=jnp.zeros(
+            (32 if params.core.model == "iocoom" else 0, T),
+            dtype=jnp.int64),
         dram_ring_start=jnp.zeros((DRAM_RING_SLOTS, T), dtype=jnp.int64),
         dram_ring_end=jnp.zeros((DRAM_RING_SLOTS, T), dtype=jnp.int64),
         dram_ring_ptr=jnp.zeros(T, dtype=jnp.int32),
         dram_qacc=jnp.zeros((6, T), dtype=jnp.float64),
         link_free_mem=noc_flight.make_link_free(T),
+        link_free_user=noc_flight.make_link_free(
+            T if params.net_user.model == "emesh_hop_by_hop" else 0),
         lock_holder=jnp.zeros(max_mutexes, dtype=jnp.int32),
         lock_free_at=jnp.zeros(max_mutexes, dtype=jnp.int64),
         bar_count=jnp.zeros(max_barriers, dtype=jnp.int32),
         bar_time=jnp.zeros(max_barriers, dtype=jnp.int64),
-        spawned_at=jnp.full(T, -1, dtype=jnp.int64),
-        done_at=jnp.zeros(T, dtype=jnp.int64),
+        spawned_at=jnp.full(S, -1, dtype=jnp.int64),
+        done_at=jnp.zeros(S, dtype=jnp.int64),
+        # Scheduler seats: streams 0..T-1 start seated on their own tile
+        # (round-robin placement strm_tile = s % T is static — see
+        # quantum.schedule_rotate); all [0]-shaped when S == T.
+        seat_stream=(jnp.arange(T, dtype=jnp.int32) if sched
+                     else jnp.zeros(0, jnp.int32)),
+        seat_since=jnp.zeros(T if sched else 0, dtype=jnp.int64),
+        seat_yield=jnp.zeros(T if sched else 0, dtype=bool),
+        strm_cursor=jnp.zeros(S if sched else 0, dtype=jnp.int32),
+        strm_clock=jnp.zeros(S if sched else 0, dtype=jnp.int64),
+        strm_pend_kind=jnp.zeros(S if sched else 0, dtype=jnp.int32),
+        strm_pend_addr=jnp.zeros(S if sched else 0, dtype=jnp.int64),
+        strm_pend_issue=jnp.zeros(S if sched else 0, dtype=jnp.int64),
+        strm_pend_aux=jnp.zeros(S if sched else 0, dtype=jnp.int32),
+        strm_pend_extra=jnp.zeros(S if sched else 0, dtype=jnp.int64),
+        strm_done=jnp.zeros(S if sched else 0, dtype=bool),
+        strm_key=(jnp.arange(S, dtype=jnp.int64) if sched
+                  else jnp.zeros(0, jnp.int64)),
         models_enabled=jnp.asarray(params.models_enabled_at_start),
         stat_filled=jnp.int32(0),
         stat_next=jnp.asarray(params.stat_interval_ps, dtype=jnp.int64),
         stat_time=jnp.zeros(_nsamp(params), dtype=jnp.int64),
-        stat_scalars=jnp.zeros((8, _nsamp(params)), dtype=jnp.int64),
+        stat_scalars=jnp.zeros((13, _nsamp(params)), dtype=jnp.int64),
         stat_icount=jnp.zeros(
             (_nsamp(params) if params.progress_enabled else 1, T),
             dtype=jnp.int64),

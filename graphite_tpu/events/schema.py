@@ -29,8 +29,9 @@ MUTEX_UNLOCK     -                      mutex id        0
 COND_WAIT        -                      cond id         mutex id (held)
 COND_SIGNAL      -                      cond id         0
 COND_BROADCAST   -                      cond id         0
-JOIN             -                      -               child tile
+JOIN             -                      -               child stream
 THREAD_START     -                      -               -
+YIELD            -                      -               -
 SYNC             wake time (ps)         cost (cycles)   0
 SPAWN            -                      cost (cycles)   child tile
 STALL            until time (ps)        -               0
@@ -165,9 +166,30 @@ class TraceBuilder:
             raise ValueError(f"tile {tile} already DONE")
         self._events[tile].append((int(op), int(addr), int(arg), int(arg2)))
 
+    # Register-operand annotations (IOCOOM scoreboard, reference
+    # iocoom_core_model.h:82 Scoreboard _register_scoreboard): events may
+    # name one source and one destination register out of NUM_REGISTERS
+    # architectural registers; ids are packed into arg2's high bits.  The
+    # reference tracks 512 Pin register ids; the trace schema compresses
+    # to 32 (frontends map ids mod 32 — a collision only adds a false
+    # dependency, which is conservative, never optimistic).
+    NUM_REGISTERS = 32
+    _REG_SRC_SHIFT = 20    # COMPUTE: bits 20-24 = src reg + 1
+    _REG_DST_SHIFT = 25    # COMPUTE: bits 25-29 = dst reg + 1
+    _MEM_DST_SHIFT = 8     # MEM_READ: bits 8-12 = dest reg + 1
+
     def compute(self, tile: int, cost_cycles: int, icount: int,
-                pc: int = 0x400000) -> None:
-        self._emit(tile, EventOp.COMPUTE, pc, cost_cycles, icount)
+                pc: int = 0x400000, src_reg: Optional[int] = None,
+                dst_reg: Optional[int] = None) -> None:
+        assert icount < (1 << self._REG_SRC_SHIFT)
+        arg2 = icount
+        if src_reg is not None:
+            assert 0 <= src_reg < self.NUM_REGISTERS
+            arg2 |= (src_reg + 1) << self._REG_SRC_SHIFT
+        if dst_reg is not None:
+            assert 0 <= dst_reg < self.NUM_REGISTERS
+            arg2 |= (dst_reg + 1) << self._REG_DST_SHIFT
+        self._emit(tile, EventOp.COMPUTE, pc, cost_cycles, arg2)
 
     def instructions(self, tile: int, types: Sequence[InstructionType],
                      pc: int = 0x400000) -> None:
@@ -176,24 +198,33 @@ class TraceBuilder:
         cost = sum(self.static_costs[t] for t in types)
         self.compute(tile, cost, len(types), pc)
 
-    def _mem(self, tile: int, op: EventOp, addr: int, size: int) -> None:
+    def _mem(self, tile: int, op: EventOp, addr: int, size: int,
+             dest_reg: Optional[int] = None) -> None:
         # Line-splitting happens here, as in the reference's core entry
         # (core.cc:173-245): one event per touched line.  Continuation
-        # events of a straddling access carry arg2=1 so instruction
-        # counting attributes the whole access to one instruction.
+        # events of a straddling access carry arg2 bit 0 = 1 so
+        # instruction counting attributes the whole access to one
+        # instruction.  ``dest_reg`` (loads) rides arg2 bits 8-12 on the
+        # first line's event — the scoreboard destination.
         end = addr + max(1, size)
         line = self.line_size
         a = addr
         first = True
+        dbits = 0
+        if dest_reg is not None:
+            assert 0 <= dest_reg < self.NUM_REGISTERS
+            dbits = (dest_reg + 1) << self._MEM_DST_SHIFT
         while a < end:
             line_end = (a // line + 1) * line
             chunk = min(end, line_end) - a
-            self._emit(tile, op, a, chunk, 0 if first else 1)
+            self._emit(tile, op, a, chunk,
+                       (0 | dbits) if first else 1)
             a += chunk
             first = False
 
-    def read(self, tile: int, addr: int, size: int = 8) -> None:
-        self._mem(tile, EventOp.MEM_READ, addr, size)
+    def read(self, tile: int, addr: int, size: int = 8,
+             dest_reg: Optional[int] = None) -> None:
+        self._mem(tile, EventOp.MEM_READ, addr, size, dest_reg=dest_reg)
 
     def write(self, tile: int, addr: int, size: int = 8) -> None:
         self._mem(tile, EventOp.MEM_WRITE, addr, size)
@@ -247,6 +278,12 @@ class TraceBuilder:
     def thread_start(self, tile: int) -> None:
         """Gate this tile's stream on being SPAWNed by another tile."""
         self._emit(tile, EventOp.THREAD_START, 0, 0, 0)
+
+    def thread_yield(self, tile: int) -> None:
+        """Give up the core so the scheduler can seat the next queued
+        stream (CarbonThreadYield; only meaningful when the trace has
+        more streams than tiles)."""
+        self._emit(tile, EventOp.YIELD, 0, 0, 0)
 
     def enable_models(self, tile: int) -> None:
         """Region-of-interest start (CarbonEnableModels): timing + counters

@@ -141,6 +141,45 @@ def gen_barrier_compute(num_tiles: int, phases: int = 8,
     return tb.build()
 
 
+def gen_threads_oversubscribed(num_streams: int, compute_blocks: int = 8,
+                               cost_cycles: int = 100,
+                               yields: int = 2) -> Trace:
+    """More app threads than tiles — the ThreadScheduler workload
+    (reference: every PARSEC config runs 64 threads on fewer cores,
+    tests/Makefile.parsec:8-26; scheduling per thread_scheduler.h:30-56).
+
+    Streams split in halves: parents (first half) spawn one child each,
+    compute with private-memory traffic, join the child, and finish;
+    children gate on THREAD_START, compute with explicit YIELDs (so
+    rotation exercises both the voluntary and preemptive paths), and
+    finish.  Run it with ``general/total_cores < num_streams`` and
+    ``max_threads_per_core >= 2``.
+    """
+    assert num_streams % 2 == 0
+    half = num_streams // 2
+    tb = TraceBuilder(num_streams)
+    for s in range(half):
+        child = half + s
+        tb.compute(s, cost_cycles, cost_cycles)
+        tb.spawn(s, child, cost_cycles=10)
+        base = PRIVATE_BASE + s * PRIVATE_SPAN
+        for b in range(compute_blocks):
+            tb.compute(s, cost_cycles, cost_cycles)
+            tb.read(s, base + (b * 64) % 4096)
+        tb.join(s, child)
+        tb.done(s)
+    for s in range(half, num_streams):
+        tb.thread_start(s)
+        base = PRIVATE_BASE + s * PRIVATE_SPAN
+        for b in range(compute_blocks):
+            tb.compute(s, cost_cycles, cost_cycles)
+            tb.write(s, base + (b * 64) % 4096)
+            if yields and b % max(compute_blocks // yields, 1) == 0:
+                tb.thread_yield(s)
+        tb.done(s)
+    return tb.build()
+
+
 def gen_lock_contention(num_tiles: int, acquisitions: int = 16,
                         critical_cycles: int = 50) -> Trace:
     """All tiles repeatedly take one mutex (reference: tests/unit/many_mutex)."""

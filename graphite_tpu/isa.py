@@ -91,6 +91,11 @@ class EventOp(enum.IntEnum):
                        # (reference: common/tile/core/syscall_model.cc packs
                        # args, common/system/syscall_server.cc:43-130 serves;
                        # arg = SyscallClass, arg2 = marshalled byte count)
+    YIELD = 24         # voluntarily give up the core: the ThreadScheduler
+                       # rotates the next queued stream onto this tile
+                       # (CarbonThreadYield -> ThreadScheduler::yieldThread,
+                       # thread_scheduler.cc:615-660; no-op when the trace
+                       # has one stream per tile)
 
 
 class SyscallClass(enum.IntEnum):

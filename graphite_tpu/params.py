@@ -289,6 +289,19 @@ class DramParams:
         )
 
 
+def pow2_grid(n: int, tall: bool) -> Tuple[int, int]:
+    """Factor a power-of-two count onto a grid (reference
+    initializeClusters / sub-cluster math, network_model_atac.cc:594-630):
+    even log2 -> square; odd -> 2:1, long side on Y when ``tall`` (the
+    cluster grid) or on X otherwise (the sub-cluster grid)."""
+    lg = n.bit_length() - 1
+    assert n == 1 << lg
+    if lg % 2 == 0:
+        return 1 << (lg // 2), 1 << (lg // 2)
+    lo, hi = 1 << ((lg - 1) // 2), 1 << ((lg + 1) // 2)
+    return (lo, hi) if tall else (hi, lo)
+
+
 @dataclasses.dataclass(frozen=True)
 class AtacParams:
     """ATAC hybrid optical-broadcast network geometry + delays
@@ -335,20 +348,14 @@ class AtacParams:
                 f"tile count {num_tiles}")
         nclust = num_tiles // csize
         # Cluster grid factorization (reference initializeClusters,
-        # network_model_atac.cc:594-618): even log2 -> square; odd ->
-        # numX = sqrt(n/2), numY = sqrt(2n) (tall).  The reference's
-        # sqrt math silently assumes a power-of-two cluster count; here
-        # that assumption is a loud check.
-        lg = nclust.bit_length() - 1
-        if nclust != 1 << lg:
+        # network_model_atac.cc:594-618).  The reference's sqrt math
+        # silently assumes a power-of-two cluster count; here that
+        # assumption is a loud check.
+        if nclust != 1 << (nclust.bit_length() - 1):
             raise ConfigError(
                 f"network/atac: cluster count {nclust} must be a power "
                 f"of two (reference initializeClusters sqrt math)")
-        if lg % 2 == 0:
-            nx = ny = 1 << (lg // 2)
-        else:
-            nx = 1 << ((lg - 1) // 2)
-            ny = 1 << ((lg + 1) // 2)
+        nx, ny = pow2_grid(nclust, tall=True)
         cw, ch = w // nx, h // ny
         if cw * nx != w or ch * ny != h:
             raise ConfigError(
@@ -539,6 +546,17 @@ class SimParams:
     quantum_ps: int
     clock_skew_scheme: str
 
+    # ThreadScheduler (reference: common/system/thread_scheduler.h:30-56 +
+    # round_robin_thread_scheduler.cc): how many app-thread streams may
+    # queue on one tile (the reference's general/max_threads_per_core
+    # knob, config.cc:48), and the preemption quantum after which a
+    # seated stream rotates out round-robin.  The reference measures its
+    # switch quantum in HOST seconds (thread_scheduler.cc:632-636,
+    # time(NULL)); here it is SIMULATED time — deterministic and
+    # host-independent, the TPU engine's native clock.
+    max_threads_per_core: int
+    thread_switch_quantum_ps: int
+
     core: CoreParams
     l1i: CacheParams
     l1d: CacheParams
@@ -580,6 +598,11 @@ class SimParams:
     progress_enabled: bool
     stat_interval_ps: int
     max_stat_samples: int
+    # Periodic power trace ([runtime_energy_modeling/power_trace],
+    # reference carbon_sim.cfg:141-145 + TileEnergyMonitor): sample the
+    # energy-bearing counters every [runtime_energy_modeling] interval
+    # and derive per-interval power (energy.power_trace).
+    power_trace_enabled: bool
 
     # TPU engine knobs
     # Window width of the block-retirement fast path (events gathered per
@@ -675,13 +698,8 @@ class SimParams:
             from graphite_tpu.energy import DVFS_LEVELS
             _check("general/technology_node", self.technology_node,
                    set(DVFS_LEVELS))
-        # (user-network emesh_hop_by_hop stays rejected until its
-        # contention path exists — resolve gates the flight machinery on
-        # the MEMORY network; silently pricing user sends zero-load under
-        # a contended model name would be the exact quiet-divergence this
-        # validator exists to stop.)
         _check("network/user model", self.net_user.model,
-               {"magic", "emesh_hop_counter", "atac"})
+               {"magic", "emesh_hop_counter", "emesh_hop_by_hop", "atac"})
         _check("network/memory model", self.net_memory.model,
                {"magic", "emesh_hop_counter", "emesh_hop_by_hop", "atac"})
         _check("branch_predictor/type", self.core.bp_type,
@@ -757,6 +775,12 @@ class SimParams:
             max_frequency_ghz=cfg.get_float("general/max_frequency"),
             quantum_ps=int(ns_to_ps(quantum_ns)),
             clock_skew_scheme=scheme,
+            max_threads_per_core=_positive(
+                cfg.get_int("general/max_threads_per_core", 1),
+                "general/max_threads_per_core"),
+            thread_switch_quantum_ps=int(ns_to_ps(_positive(
+                cfg.get_int("thread_scheduling/switch_quantum", 10_000),
+                "thread_scheduling/switch_quantum"))),
             core=CoreParams.from_config(cfg, core_type),
             l1i=l1i,
             l1d=l1d,
@@ -789,7 +813,13 @@ class SimParams:
                 (cfg.get_int("statistics_trace/sampling_interval")
                  if cfg.get_bool("statistics_trace/enabled") else 1 << 40),
                 (cfg.get_int("progress_trace/interval")
-                 if cfg.get_bool("progress_trace/enabled") else 1 << 40)))),
+                 if cfg.get_bool("progress_trace/enabled") else 1 << 40),
+                (cfg.get_int("runtime_energy_modeling/interval", 1000)
+                 if cfg.get_bool(
+                     "runtime_energy_modeling/power_trace/enabled", False)
+                 else 1 << 40)))),
+            power_trace_enabled=cfg.get_bool(
+                "runtime_energy_modeling/power_trace/enabled", False),
             max_stat_samples=cfg.get_int("tpu/max_stat_samples", 1024),
             block_events=_block_events(cfg.get_int("tpu/block_events", 16)),
             max_events_per_quantum=cfg.get_int("tpu/max_events_per_quantum"),

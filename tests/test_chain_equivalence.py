@@ -47,9 +47,9 @@ def _run(trace, num_tiles, miss_chain, **over):
 
 def _counters_equal(a, b):
     """Event conservation: both engines must observe the same work."""
-    for k in ("instructions", "l1d_read", "l1d_write", "branches"):
-        if k in a.counters and k in b.counters:
-            np.testing.assert_array_equal(a.counters[k], b.counters[k], k)
+    for k in ("icount", "l1d_read", "l1d_write", "branches"):
+        assert k in a.counters and k in b.counters, k
+        np.testing.assert_array_equal(a.counters[k], b.counters[k], k)
 
 
 @pytest.mark.xfail(

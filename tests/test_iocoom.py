@@ -117,3 +117,64 @@ def test_iocoom_radix_runs_and_beats_simple_time():
             == int(np.asarray(simple.state.counters.icount).sum()))
     assert (int(np.asarray(ioc.state.clock).max())
             <= int(np.asarray(simple.state.clock).max()))
+
+
+def test_register_scoreboard_raw_stall():
+    """The scoreboard's defining effect (reference
+    iocoom_core_model.h:82, .cc:119-143): a compute consuming a missing
+    load's DEST register stalls until the load completes; the identical
+    trace without the register dependence retires the compute behind the
+    miss.  Register dependence must CHANGE timing."""
+    def trace(dep: bool):
+        tb = TraceBuilder(2)
+        # Remote-miss load into r5 (shared address: L1/L2 cold miss).
+        tb.read(0, synth.SHARED_BASE, 8, dest_reg=5 if dep else None)
+        # Long independent compute then a compute reading r5.
+        tb.compute(0, cost_cycles=10, icount=1,
+                   src_reg=5 if dep else None)
+        tb.stall_until(1, 1)
+        return tb.build()
+
+    p = make_params("iocoom")
+    with_dep = _run(p, trace(True))
+    without = _run(p, trace(False))
+    t_dep = int(np.asarray(with_dep.state.clock)[0])
+    t_free = int(np.asarray(without.state.clock)[0])
+    # Without the dependence the compute issues at load-issue + 1 cycle;
+    # with it, it waits out the full remote round trip.
+    assert t_dep > t_free
+
+
+def test_register_scoreboard_chain():
+    """Dependent chain r1 -> r2 -> r3 serializes; independent versions of
+    the same computes overlap the load latency."""
+    def trace(dep: bool):
+        tb = TraceBuilder(2)
+        tb.read(0, synth.SHARED_BASE, 8, dest_reg=1 if dep else None)
+        tb.compute(0, 5, 1, src_reg=1 if dep else None,
+                   dst_reg=2 if dep else None)
+        tb.compute(0, 5, 1, src_reg=2 if dep else None,
+                   dst_reg=3 if dep else None)
+        tb.compute(0, 5, 1, src_reg=3 if dep else None)
+        tb.stall_until(1, 1)
+        return tb.build()
+
+    p = make_params("iocoom")
+    t_dep = int(np.asarray(_run(p, trace(True)).state.clock)[0])
+    t_free = int(np.asarray(_run(p, trace(False)).state.clock)[0])
+    assert t_dep > t_free
+
+
+def test_scoreboard_hit_load_feeds_register():
+    """An L1-hitting load writes its register at the hit completion —
+    the dependent compute pays only the L1 latency, far less than a
+    miss round trip."""
+    tb = TraceBuilder(2)
+    base = synth.PRIVATE_BASE
+    tb.read(0, base, 8)              # warm the line (miss, fills L1)
+    tb.read(0, base, 8, dest_reg=7)  # L1 hit into r7
+    tb.compute(0, 5, 1, src_reg=7)
+    tb.stall_until(1, 1)
+    p = make_params("iocoom")
+    s = _run(p, tb.build())
+    assert bool(np.asarray(s.state.done).all())

@@ -133,3 +133,35 @@ def test_log_module_filtering(capsys):
         raise RuntimeError("unreachable")
     except AssertionError as e:
         assert "bad state" in str(e)
+
+
+def test_power_trace(tmp_path):
+    """[runtime_energy_modeling/power_trace] produces per-interval power
+    samples and a CSV (reference carbon_sim.cfg:141-145 +
+    TileEnergyMonitor's periodic roll-up)."""
+    params = make_params(
+        tiles=4,
+        **{"runtime_energy_modeling/power_trace/enabled": "true",
+           "runtime_energy_modeling/interval": 2000})
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=32, radix=8, seed=6)
+    s = run_simulation(params, trace, max_steps=64)
+    assert s.done.all()
+    pt = s.power_trace()
+    assert len(pt["time_ns"]) >= 1
+    assert (pt["total_w"] > 0).all()
+    assert (pt["leakage_w"] > 0).all()
+    # Dynamic power is nonnegative and finite.
+    assert np.isfinite(pt["dynamic_w"]).all()
+    assert (pt["dynamic_w"] >= 0).all()
+    out = tmp_path / "trace.power.csv"
+    s.write_power_trace(str(out))
+    lines = out.read_text().strip().splitlines()
+    assert lines[0] == "time_ns,dynamic_w,leakage_w,total_w"
+    assert len(lines) == len(pt["time_ns"]) + 1
+
+
+def test_power_trace_off_no_samples():
+    params = make_params(tiles=2)
+    trace = synth.gen_radix(num_tiles=2, keys_per_tile=16, radix=8)
+    s = run_simulation(params, trace, max_steps=64)
+    assert s.power_trace()["time_ns"].size == 0

@@ -1,0 +1,93 @@
+"""ThreadScheduler: multi-thread-per-core seat rotation (reference:
+common/system/thread_scheduler.h:30-56, round_robin_thread_scheduler.cc,
+yield path thread_scheduler.cc:615-660).
+
+A trace with more streams than tiles engages the scheduler: streams place
+round-robin (stream % tiles), one stream per tile runs at a time, and
+seats rotate FCFS on done / YIELD / unspawned THREAD_START / preemption
+quantum.  With streams == tiles the seat layer is compiled out and the
+engine is bit-identical to the 1:1 world (the existing suite covers it).
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+pytestmark = pytest.mark.quick
+
+
+def _run(trace, num_tiles, threads_per_core=4, **over):
+    cfg = load_config()
+    cfg.set("general/total_cores", num_tiles)
+    cfg.set("general/max_threads_per_core", threads_per_core)
+    for k, v in over.items():
+        cfg.set(k, v)
+    params = SimParams.from_config(cfg)
+    sim = Simulator(params, trace)
+    return sim.run(max_steps=256)
+
+
+def test_two_threads_per_tile_completes():
+    """2x oversubscription: every stream (parents + spawned children with
+    YIELDs) retires — the VERDICT r4 'done' bar."""
+    trace = synth.gen_threads_oversubscribed(num_streams=8)
+    s = _run(trace, 4)
+    assert s.done.all()
+    assert s.completion_time_ps > 0
+    # Both halves' instructions retired (parents: 1+8 blocks, children: 8).
+    assert s.total_instructions > 0
+
+
+def test_oversubscription_serializes_compute():
+    """Two streams time-share one core: completion is strictly later than
+    the same work spread across twice the tiles (the seat serializes)."""
+    trace = synth.gen_threads_oversubscribed(num_streams=8,
+                                             compute_blocks=16)
+    packed = _run(trace, 4)
+    spread = _run(trace, 8, threads_per_core=1)
+    assert packed.done.all() and spread.done.all()
+    assert packed.completion_time_ps > spread.completion_time_ps
+
+
+def test_deterministic():
+    trace = synth.gen_threads_oversubscribed(num_streams=8)
+    a = _run(trace, 4)
+    b = _run(trace, 4)
+    assert a.completion_time_ps == b.completion_time_ps
+    for k in a.counters:
+        np.testing.assert_array_equal(a.counters[k], b.counters[k], k)
+
+
+def test_equals_one_to_one_when_not_oversubscribed():
+    """A streams==tiles trace must be untouched by the scheduler config
+    knob (the seat layer only engages when streams > tiles)."""
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=2)
+    a = _run(trace, 4, threads_per_core=1)
+    b = _run(trace, 4, threads_per_core=4)
+    assert a.completion_time_ps == b.completion_time_ps
+
+
+def test_overflow_rejected():
+    """streams > tiles x max_threads_per_core fails loudly (reference
+    asserts the same overflow, thread_scheduler.cc:577)."""
+    trace = synth.gen_threads_oversubscribed(num_streams=8)
+    with pytest.raises(ValueError, match="max_threads_per_core"):
+        _run(trace, 4, threads_per_core=1)
+
+
+def test_fewer_streams_than_tiles_rejected():
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=8, radix=8)
+    with pytest.raises(ValueError, match="streams"):
+        _run(trace, 8)
+
+
+def test_four_threads_per_tile():
+    """Deeper oversubscription on fewer tiles still drains round-robin."""
+    trace = synth.gen_threads_oversubscribed(num_streams=8,
+                                             compute_blocks=4)
+    s = _run(trace, 2)
+    assert s.done.all()
