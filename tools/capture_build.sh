@@ -15,7 +15,8 @@ make -s -C "$here/native" build/libcarbon_tsan.a
 WRAPS=(pthread_create pthread_join pthread_mutex_init pthread_mutex_lock
        pthread_mutex_unlock pthread_cond_init pthread_cond_wait
        pthread_cond_signal pthread_cond_broadcast pthread_barrier_init
-       pthread_barrier_wait read write open close lseek access)
+       pthread_barrier_wait read write open close lseek access
+       mmap munmap brk)
 wrapflags=()
 for w in "${WRAPS[@]}"; do wrapflags+=("-Wl,--wrap,$w"); done
 
@@ -35,14 +36,17 @@ tmpd="$(mktemp -d)"
 trap 'rm -rf "$tmpd"' EXIT
 for s in "${srcs[@]}"; do
     o="$tmpd/$(basename "${s%.*}").o"
-    gcc -O1 -g -fsanitize=thread -fno-omit-frame-pointer \
+    gcc -O1 -g -fsanitize=thread \
+        -fsanitize-coverage=trace-pc -fno-omit-frame-pointer \
         "${extra[@]}" -c "$s" -o "$o"
     objs+=("$o")
 done
 
 # Link WITHOUT -fsanitize=thread so libtsan is not pulled in; our runtime
 # provides every __tsan_* symbol the instrumentation references.
-gcc "${objs[@]}" "${wrapflags[@]}" \
+# -no-pie keeps runtime addresses equal to objdump's static addresses so
+# tools/annotate_trace.py can map captured block pcs to decoded blocks.
+gcc "${objs[@]}" "${wrapflags[@]}" -no-pie \
     "$here/native/build/libcarbon_tsan.a" \
     -lpthread -lstdc++ -lm -o "$out"
 echo "built $out (capture-instrumented)"
