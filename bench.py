@@ -114,6 +114,11 @@ _CAPTURES = {
     "fft": dict(srcs=["fft/fft.C"], args=["-p64", "-m12"], libs=["-lm"]),
     "lu": dict(srcs=["lu_contiguous/lu.C"], args=["-p64", "-n64"],
                libs=["-lm"]),
+    # barnes is trace-dense (TSan instruments the whole O(N log N) force
+    # phase), so its capture runs under a first-120k-events-per-tile
+    # sampling window (CARBON_MAX_EVENTS_PER_TILE keeps the sync
+    # skeleton complete past the cap, so the trace still runs to
+    # completion; timing covers the captured prefix).
     "barnes": dict(srcs=["barnes/code.C", "barnes/code_io.C",
                          "barnes/getparam.C", "barnes/load.C",
                          "barnes/grav.C", "barnes/util.C"],
@@ -122,9 +127,10 @@ _CAPTURES = {
                             "barnes/grav.H", "barnes/load.H",
                             "barnes/stdinc.H", "barnes/util.H",
                             "barnes/vectmath.H"],
-                   args=[], libs=["-lm"],
-                   stdin="\n256\n123\n\n0.025\n0.05\n1.0\n2.0\n5.0\n"
-                         "0.05\n0.25\n64\n"),
+                   args=[], libs=["-lm"], tiles=32,
+                   env={"CARBON_MAX_EVENTS_PER_TILE": "120000"},
+                   stdin="\n128\n123\n\n0.025\n0.05\n1.0\n2.0\n5.0\n"
+                         "0.05\n0.25\n32\n"),
 }
 
 
@@ -183,7 +189,8 @@ def _captured_row(name: str):
                 check=True, capture_output=True)
             trace_path = os.path.join(td, f"{name}.trc")
             env = dict(os.environ, CARBON_TRACE_PATH=trace_path,
-                       CARBON_MAX_TILES="64")
+                       CARBON_MAX_TILES=str(spec.get("tiles", 64)),
+                       **spec.get("env", {}))
             subprocess.run([exe, *spec["args"]], check=True, env=env,
                            capture_output=True, timeout=600,
                            input=spec.get("stdin", "").encode() or None)
@@ -198,9 +205,12 @@ def _captured_row(name: str):
             trace = _pad_trace(load_binary_trace(trace_path))
     except Exception as e:   # missing toolchain, capture failure, ...
         return {"kind": "skipped", "reason": str(e)[:200]}
-    row = _run(lambda T: trace, trace.num_tiles,
-               **{"general/trigger_models_within_application": "true",
-                  "tpu/cond_replay": "true"})
+    try:
+        row = _run(lambda T: trace, trace.num_tiles,
+                   **{"general/trigger_models_within_application": "true",
+                      "tpu/cond_replay": "true"})
+    except Exception as e:   # device OOM on an oversize capture, ...
+        return {"kind": "skipped", "reason": str(e)[:200]}
     row["workload"] = f"SPLASH-2 {name} (captured, unmodified source)"
     return row
 
@@ -223,21 +233,30 @@ def main() -> int:
         "detail": {"radix64": main_run},
     }
     det = out["detail"]
+
+    def safe(key, fn):
+        """One broken row must not void the whole benchmark (the r4
+        bench died whole and left the round numberless)."""
+        try:
+            det[key] = fn()
+        except Exception as e:
+            det[key] = {"kind": "failed", "reason": str(e)[:200]}
+
     # BASELINE config 1 scaling: radix at 256 and 1024 tiles.  Every
     # point COMPLETES (valid MIPS) — the 1024 row runs a narrow block
     # window (the trace is miss-dominated, so a wide window only pays
     # gather cost) on a completion-sized key count; this is the config
     # the north star scores (BASELINE.json).
-    det["radix256"] = _run(radix(96), 256)
-    det["radix1024"] = _run(
+    safe("radix256", lambda: _run(radix(96), 256))
+    safe("radix1024", lambda: _run(
         lambda T: synth.gen_radix(T, keys_per_tile=16, radix=64), 1024,
-        **{"tpu/block_events": 4})
+        **{"tpu/block_events": 4}))
     # BASELINE config 2: directory-MSI coherence stress at 256 tiles,
     # sized to complete.
-    det["fft256"] = _run(
-        lambda T: synth.gen_fft(T, points_per_tile=64), 256)
-    det["lu256"] = _run(
-        lambda T: synth.gen_lu(T, matrix_blocks=8, block_lines=4), 256)
+    safe("fft256", lambda: _run(
+        lambda T: synth.gen_fft(T, points_per_tile=64), 256))
+    safe("lu256", lambda: _run(
+        lambda T: synth.gen_lu(T, matrix_blocks=8, block_lines=4), 256))
     # Real workloads: reference SPLASH-2 programs captured from
     # UNMODIFIED vendored source via the TSan frontend (VERDICT r4
     # missing #9 — fft/lu/barnes as real captures, not synthetics).

@@ -148,19 +148,16 @@ def schedule_rotate(params: SimParams, state: SimState) -> SimState:
     # chain (mq_count > 0, tpu/miss_chain > 0) is tile-resident bank
     # state belonging to the seated stream — rotating under it would
     # drain the old stream's banked requests against the new stream's
-    # clock.  Barrier/cond-family parks hold their seat too: their wakes
-    # are RELEASE-EDGE events (resolve_barrier resets bar_count on
-    # release; cond tokens are consumed when matched) that only seated
-    # parks observe — a rotated-out parker would miss its generation and
-    # hang.  Consequence, documented: a barrier spanning more
-    # participants than tiles cannot run oversubscribed in v1 (all
-    # participants must hold seats simultaneously); mutex/join/recv/
-    # send/start parks rotate freely — their wake conditions are
-    # persistent state re-checked whenever the stream is reseated.
+    # clock.  EVERY sync park rotates freely (preemption must be able to
+    # seat the peer a parked stream is waiting FOR — pinning any sync
+    # park can deadlock a waiter queued on the same tile as its waker):
+    # mutex/join/recv/send/start wakes are persistent state re-checked on
+    # reseat; cond signal/broadcast tokens are durable parked entries
+    # whose loss bound covers descheduled streams (resolve_cond lb);
+    # barrier releases wake descheduled waiters directly in the stream
+    # store (resolve_barrier).
     mem_park = ((k == PEND_SH_REQ) | (k == PEND_EX_REQ)
-                | (k == PEND_IFETCH)) | (state.mq_count > 0) \
-        | (k == PEND_BARRIER) | (k == PEND_COND) \
-        | (k == PEND_CSIG) | (k == PEND_CBC)
+                | (k == PEND_IFETCH)) | (state.mq_count > 0)
     unspawned_gate = (k == PEND_START) \
         & (state.spawned_at[sst] < 0)
     expired = (state.boundary - state.seat_since) \

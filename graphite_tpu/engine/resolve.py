@@ -1870,6 +1870,26 @@ def resolve_barrier(params: SimParams, state: SimState) -> SimState:
                              jnp.full(T, mcp_tile(params)), rows, CTRL_BYTES,
                              p_nu[mcp_tile(params)], params.mesh_width)
     completion = state.bar_time[bid] + back_ps + cycle_ps
+    if state.sched_enabled:
+        # Wake DESCHEDULED waiters of released barriers directly in the
+        # stream store — the release edge resets bar_count below, so a
+        # rotated-out parker would otherwise miss its generation
+        # (ThreadScheduler; the reference's barrier server wakes every
+        # registered waiter regardless of scheduling).  Their arrival
+        # was already counted at park time.
+        S = state.strm_cursor.shape[0]
+        s_is = state.strm_pend_kind == PEND_BARRIER
+        sbid = jnp.clip(state.strm_pend_addr, 0, NB - 1).astype(jnp.int32)
+        sparts = jnp.maximum(state.strm_pend_aux, 1)
+        s_rel = s_is & (state.bar_count[sbid] >= sparts) \
+            & ~state.strm_done
+        s_tile = (jnp.arange(S, dtype=jnp.int32) % T)
+        s_comp = state.bar_time[sbid] + back_ps[s_tile] + cycle_ps[s_tile]
+        state = state._replace(
+            strm_pend_kind=jnp.where(s_rel, PEND_NONE,
+                                     state.strm_pend_kind),
+            strm_clock=jnp.where(s_rel, s_comp, state.strm_clock),
+            strm_cursor=state.strm_cursor + jnp.where(s_rel, 1, 0))
     # reset released barriers for their next generation
     bid_eff = jnp.where(rel, bid, NB)
     state = state._replace(
@@ -2016,12 +2036,30 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
                   jnp.where(state.pend_kind == PEND_MUTEX,
                             state.pend_issue + to_mcp + 1,
                             state.pend_issue + 1)))
+    if state.sched_enabled:
+        # Descheduled streams can still park (or already hold a park)
+        # with timestamps at or past their frozen clocks — a token must
+        # not be declared lost/complete while such a stream could still
+        # match it (ThreadScheduler; the store's parked COND waiters
+        # match when reseated, since tokens are durable parked entries).
+        lb_store = jnp.where(
+            state.strm_done, INF,
+            jnp.where(state.strm_pend_kind == PEND_NONE,
+                      state.strm_clock, state.strm_pend_issue + 1))
+        # Exclude currently-seated streams (their seat rows carry the
+        # live values; the store copy is stale for them).
+        seated = jnp.zeros(lb_store.shape[0], dtype=bool).at[
+            state.seat_stream].set(True)
+        store_min = jnp.min(jnp.where(seated, INF, lb_store))
+    else:
+        store_min = INF
     if lb.shape[0] >= 2:
         neg2 = jax.lax.top_k(-lb, 2)[0]
         m1, m2 = -neg2[0], -neg2[1]
         lb_excl = jnp.where(lb == m1, m2, m1)  # min over the OTHER tiles
     else:
         lb_excl = jnp.full_like(lb, INF)       # no other tiles exist
+    lb_excl = jnp.minimum(lb_excl, store_min)
     woke_nc = dense.binsum(oh_c, wake & ~w_bc, 1) > 0
     woke_mine = _sel(oh_c, woke_nc.astype(jnp.int32)) > 0
     if params.cond_replay:

@@ -246,11 +246,12 @@ def gen_radix(num_tiles: int, keys_per_tile: int = 4096, radix: int = 256,
         width = num_tiles
         for lvl in range(levels):
             pair = t >> (lvl + 1)
-            # The lower sibling of each pair merges: read both child
-            # nodes, write the parent (reference: the later arrival
-            # merges; which one is timing detail, the traffic is one
-            # merge per pair per level).
-            if (t >> lvl) % 2 == 0 and width > 1:
+            # ONE representative tile per pair merges (the pair's lowest
+            # tile): read both child nodes, write the parent.  The
+            # reference lets the later arrival merge; which sibling does
+            # it is timing detail — the modeled traffic is one merge per
+            # pair per level, O(T) total merges.
+            if t % (1 << (lvl + 1)) == 0 and width > 1:
                 sib = node_base + (t >> lvl) + 1
                 parent = node_base + width + pair
                 for d in range(0, radix, stride):

@@ -67,9 +67,36 @@ struct Runtime {
 Runtime *g_rt = nullptr;
 thread_local int tl_tile = -1;
 
+/* CARBON_MAX_EVENTS_PER_TILE: sampling window for trace-dense programs
+ * (0 = unlimited).  Past the cap a tile keeps recording ONLY sync and
+ * lifecycle events (spawn/join/mutex/cond/barrier/sync/DONE), so the
+ * sync skeleton stays balanced and the trace still simulates to
+ * completion — the standard first-N-events sampling window; timing is
+ * representative of the captured prefix. */
+long g_max_events_per_tile = 0;
+
+bool sync_op(int op) {
+    switch (op) {
+        case CARBON_EV_SPAWN: case CARBON_EV_SYNC:
+        case CARBON_EV_DONE: case CARBON_EV_BARRIER_WAIT:
+        case CARBON_EV_MUTEX_LOCK: case CARBON_EV_MUTEX_UNLOCK:
+        case CARBON_EV_COND_WAIT: case CARBON_EV_COND_SIGNAL:
+        case CARBON_EV_COND_BROADCAST: case CARBON_EV_JOIN:
+        case CARBON_EV_THREAD_START: case CARBON_EV_RECV:
+        case CARBON_EV_SEND:
+            return true;
+        default:
+            return false;
+    }
+}
+
 void emit(int op, int64_t addr = 0, int arg = 0, int arg2 = 0) {
     if (!g_rt || tl_tile < 0) return;
-    g_rt->tiles[tl_tile].events.push_back(
+    auto &evs = g_rt->tiles[tl_tile].events;
+    if (g_max_events_per_tile > 0
+        && (long)evs.size() >= g_max_events_per_tile && !sync_op(op))
+        return;
+    evs.push_back(
         Event{(int32_t)op, 0, addr, (int32_t)arg, (int32_t)arg2});
 }
 
@@ -124,6 +151,8 @@ int CarbonStartSim(int max_tiles) {
     g_rt->max_tiles = max_tiles;
     g_rt->tiles.resize(max_tiles);
     g_rt->started = true;
+    const char *cap = getenv("CARBON_MAX_EVENTS_PER_TILE");
+    g_max_events_per_tile = cap ? atol(cap) : 0;
     tl_tile = 0;
     return 0;
 }

@@ -91,3 +91,24 @@ def test_four_threads_per_tile():
                                              compute_blocks=4)
     s = _run(trace, 2)
     assert s.done.all()
+
+
+def test_oversubscribed_barrier_across_all_streams():
+    """A barrier spanning MORE participants than tiles (every PARSEC
+    phase barrier) completes: released waiters that are descheduled at
+    release time are woken directly in the stream store
+    (resolve_barrier; without it the count reset strands them)."""
+    from graphite_tpu.events.schema import TraceBuilder
+    tb = TraceBuilder(8)
+    for s in range(4):
+        tb.compute(s, 20, 10)
+        tb.spawn(s, 4 + s)
+        tb.barrier(s, 0, 8)
+        tb.done(s)
+    for s in range(4, 8):
+        tb.thread_start(s)
+        tb.compute(s, 50, 20)
+        tb.barrier(s, 0, 8)
+        tb.done(s)
+    s = _run(tb.build(), 4, threads_per_core=2)
+    assert s.done.all()

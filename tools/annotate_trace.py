@@ -33,11 +33,15 @@ import sys
 
 import numpy as np
 
+# Mnemonic must start with a letter: objdump continuation lines of
+# >7-byte instructions are bytes-only ("  7:\t33 22 11 ") and must not
+# read as a phantom instruction.
 _INSN = re.compile(
-    r"^\s*([0-9a-f]+):\s+(?:[0-9a-f]{2} )+\s*([a-z0-9.]+)\s*(.*)$")
+    r"^\s*([0-9a-f]+):\s+(?:[0-9a-f]{2} )+\s*([a-z][a-z0-9.]*)\s*(.*)$")
 _COV_CALL = re.compile(
     r"^\s*([0-9a-f]+):\s+(?:[0-9a-f]{2} )+\s*call[ql]?\s+\S+ "
     r"<__sanitizer_cov_trace_pc(?:@plt)?>")
+_FUNC_HDR = re.compile(r"^[0-9a-f]+ <[^>]+>:$")
 
 # Mnemonic -> InstructionType.config_key (x86-64; anything unlisted is
 # 'generic').  Mirrors the groups of the reference decode
@@ -93,33 +97,43 @@ def block_table(binary: str, costs=None):
     costs = dict(_DEFAULT_COSTS, **(costs or {}))
     out = subprocess.run(["objdump", "-d", binary], check=True,
                          capture_output=True, text=True).stdout
-    # Pass 1: probe call sites (block starts) in address order.
-    sites = []          # (call_addr, ret_addr)
-    insns = []          # (addr, mnemonic, operands)
+    # Pass 1: probe call sites (block starts) + function boundaries, in
+    # address order.  A block body must not run past its function's end
+    # — without the boundary, the last block of every instrumented
+    # function would swallow the next function's pre-probe prologue
+    # (and the address-wise last app block the whole uninstrumented
+    # runtime).
+    sites = []          # probe call addresses
+    insns = []          # (addr, mnemonic, operands, func_id)
+    func_id = 0
     for line in out.splitlines():
+        if _FUNC_HDR.match(line):
+            func_id += 1
+            continue
         mc = _COV_CALL.match(line)
         mi = _INSN.match(line)
         if mi:
             addr = int(mi.group(1), 16)
-            insns.append((addr, mi.group(2), mi.group(3)))
+            insns.append((addr, mi.group(2), mi.group(3), func_id))
             if mc:
                 sites.append(addr)
     if not sites:
         return {}
     # ret addr of call k = address of the next instruction after it.
-    addr_index = {a: i for i, (a, _, _) in enumerate(insns)}
+    addr_index = {a: i for i, (a, _, _, _) in enumerate(insns)}
     table = {}
     site_set = set(sites)
-    for k, call_addr in enumerate(sites):
+    for call_addr in sites:
         i = addr_index[call_addr] + 1
         if i >= len(insns):
             continue
         ret_addr = insns[i][0]
+        fid = insns[i][3]
         icount = 0
         cost = 0
         while i < len(insns):
-            addr, mn, ops = insns[i]
-            if addr in site_set:          # next block's probe
+            addr, mn, ops, f = insns[i]
+            if addr in site_set or f != fid:   # next probe / next func
                 break
             # Exclude instrumentation calls (capture overhead).
             if mn.startswith("call") and _SKIP_CALL.search(ops):
@@ -165,6 +179,12 @@ def annotate_raw(binary: str, trace_in: str, trace_out=None, costs=None):
             (n,) = struct.unpack("<I", f.read(4))
             per_tile.append(np.frombuffer(
                 f.read(n * _REC.itemsize), dtype=_REC).copy())
+    if not table:
+        # No probe sites decoded (binary built without coverage, or a
+        # foreign guard ABI) — keep the runtime estimates.
+        print("annotate_trace: no static blocks decoded; keeping runtime "
+              "estimates", file=sys.stderr)
+        return 0, 0
     # Vectorized rewrite: sorted block-table lookup per COMPUTE pc
     # (captures emit one COMPUTE per executed block — 10^7+ events for a
     # real benchmark; a per-event Python loop would cost minutes).
@@ -203,7 +223,9 @@ def main(argv):
         return 2
     binary, tin = argv[1], argv[2]
     tout = argv[3] if len(argv) > 3 else tin
-    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    import os
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
     annotate_raw(binary, tin, tout)
     return 0
 

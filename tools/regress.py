@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Regression-matrix harness: run a workload x tiles x protocol matrix.
+
+The reference's regression flow builds a benchmark matrix and schedules
+it through a job queue, collecting each run's results under a dated
+directory with a ``results/latest`` symlink (reference:
+tools/regress/run_tests.py:12-50, tools/schedule.py,
+tools/regress/aggregate_results.py; output-dir convention
+carbon_sim.cfg:12-30).  Same shape here, simulator-as-library:
+
+    python tools/regress.py [--quick] [--out results]
+
+runs the matrix serially (one TPU chip — the reference parallelizes
+across hosts; the job-queue analog is the driver loop), writes one
+summary + JSON row per cell into ``results/<date>/``, updates
+``results/latest``, and aggregates everything into ``aggregate.csv``
+and a results database (tools/results_db.py, the db_utils analog).
+Exit status is non-zero if any cell fails — the reference's
+"did every target print PASSED" oracle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# workload name -> (generator kwargs factory)
+_QUICK = [
+    ("radix", 16, "pr_l1_pr_l2_dram_directory_msi",
+     dict(keys_per_tile=32, radix=16)),
+    ("radix", 16, "pr_l1_pr_l2_dram_directory_mosi",
+     dict(keys_per_tile=32, radix=16)),
+    ("fft", 16, "pr_l1_pr_l2_dram_directory_msi",
+     dict(points_per_tile=32)),
+]
+_FULL = _QUICK + [
+    ("radix", 64, "pr_l1_pr_l2_dram_directory_msi",
+     dict(keys_per_tile=64, radix=64)),
+    ("radix", 64, "pr_l1_sh_l2_mesi", dict(keys_per_tile=64, radix=64)),
+    ("lu", 64, "pr_l1_pr_l2_dram_directory_msi",
+     dict(matrix_blocks=4, block_lines=4)),
+    ("barrier_compute", 64, "pr_l1_pr_l2_dram_directory_msi",
+     dict(phases=4)),
+]
+
+
+def _gen(name: str, tiles: int, kw: dict):
+    from graphite_tpu.events import synth
+    return getattr(synth, f"gen_{name}")(tiles, **kw)
+
+
+def run_cell(name: str, tiles: int, protocol: str, kw: dict, outdir: str):
+    from graphite_tpu.config import load_config
+    from graphite_tpu.engine.sim import Simulator
+    from graphite_tpu.params import SimParams
+    cfg = load_config()
+    cfg.set("general/total_cores", tiles)
+    cfg.set("caching_protocol/type", protocol)
+    params = SimParams.from_config(cfg)
+    sim = Simulator(params, _gen(name, tiles, kw))
+    summary = sim.run(max_steps=512)
+    d = summary.to_dict()
+    cell = f"{name}_t{tiles}_{protocol.split('_')[-1]}"
+    with open(os.path.join(outdir, cell + ".json"), "w") as f:
+        json.dump(d, f, indent=1, default=str)
+    with open(os.path.join(outdir, cell + ".out"), "w") as f:
+        f.write(summary.render())
+    ok = bool(d["all_done"])
+    print(f"{'PASSED' if ok else 'FAILED'} {cell} "
+          f"({d['completion_time_ns']:.0f} ns, "
+          f"{d['total_instructions']} instr)", flush=True)
+    return cell, ok, d
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=os.path.join(REPO, "results"))
+    args = ap.parse_args()
+
+    stamp = datetime.datetime.now().strftime("%Y-%m-%d_%H-%M")
+    outdir = os.path.join(args.out, stamp)
+    os.makedirs(outdir, exist_ok=True)
+    latest = os.path.join(args.out, "latest")
+    if os.path.islink(latest):
+        os.unlink(latest)
+    if not os.path.exists(latest):
+        os.symlink(stamp, latest)
+
+    matrix = _QUICK if args.quick else _FULL
+    rows = []
+    failed = 0
+    for name, tiles, protocol, kw in matrix:
+        try:
+            cell, ok, d = run_cell(name, tiles, protocol, kw, outdir)
+        except Exception as e:          # a crashed cell fails the matrix
+            print(f"FAILED {name}_t{tiles}: {e}", flush=True)
+            failed += 1
+            continue
+        failed += 0 if ok else 1
+        rows.append({
+            "cell": cell, "workload": name, "tiles": tiles,
+            "protocol": protocol, "all_done": ok,
+            "completion_time_ns": d["completion_time_ns"],
+            "total_instructions": d["total_instructions"],
+        })
+    with open(os.path.join(outdir, "aggregate.csv"), "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0].keys()) if rows
+                           else ["cell"])
+        w.writeheader()
+        w.writerows(rows)
+    # Log into the results DB (db_utils analog).
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from results_db import add_run, open_db
+    db = open_db(os.path.join(args.out, "results.db"))
+    for r in rows:
+        add_run(db, r["cell"], r)
+    print(f"{len(rows) - failed}/{len(matrix)} cells passed; results in "
+          f"{outdir}", flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""SQLite results database for simulation/benchmark runs.
+
+The reference ships a small SQLite helper library that benchmark
+harnesses log results through (reference: contrib/db_utils/api.h,
+access.cc, initialize.cc — built as libdb_utils.a, Makefile:8).  This is
+its host-side analog: one table of runs keyed by (workload, config),
+storing the summary metrics plus the raw JSON row, with the same
+append-then-query workflow.
+
+Usage:
+    python tools/results_db.py add results.db bench_row.json
+    python tools/results_db.py add results.db - < row.json
+    python tools/results_db.py list results.db [workload]
+    python tools/results_db.py best results.db workload metric
+
+Importable: ``open_db``, ``add_run``, ``query``.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import sys
+import time
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS runs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts REAL NOT NULL,
+    workload TEXT NOT NULL,
+    num_tiles INTEGER,
+    kind TEXT,
+    mips REAL,
+    events_per_sec REAL,
+    host_seconds REAL,
+    completion_time_ns REAL,
+    raw_json TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS runs_workload ON runs (workload, ts);
+"""
+
+
+def open_db(path: str) -> sqlite3.Connection:
+    db = sqlite3.connect(path)
+    db.executescript(_SCHEMA)
+    return db
+
+
+def add_run(db: sqlite3.Connection, workload: str, row: dict,
+            ts: float = None) -> int:
+    cur = db.execute(
+        "INSERT INTO runs (ts, workload, num_tiles, kind, mips, "
+        "events_per_sec, host_seconds, completion_time_ns, raw_json) "
+        "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        (ts if ts is not None else time.time(), workload,
+         row.get("num_tiles"), row.get("kind"), row.get("mips"),
+         row.get("events_per_sec"), row.get("host_seconds"),
+         row.get("completion_time_ns"), json.dumps(row)))
+    db.commit()
+    return cur.lastrowid
+
+
+def query(db: sqlite3.Connection, workload: str = None):
+    q = ("SELECT ts, workload, num_tiles, kind, mips, events_per_sec, "
+         "host_seconds FROM runs")
+    args = ()
+    if workload:
+        q += " WHERE workload = ?"
+        args = (workload,)
+    return db.execute(q + " ORDER BY ts", args).fetchall()
+
+
+def main(argv) -> int:
+    if len(argv) < 3:
+        print(__doc__)
+        return 2
+    cmd, path = argv[1], argv[2]
+    db = open_db(path)
+    if cmd == "add":
+        src = argv[3] if len(argv) > 3 else "-"
+        text = sys.stdin.read() if src == "-" else open(src).read()
+        data = json.loads(text)
+        # Accept either a bench.py top-level object (detail rows) or a
+        # single row.
+        if "detail" in data:
+            for name, row in data["detail"].items():
+                if isinstance(row, dict):
+                    add_run(db, name, row)
+            print(f"added {len(data['detail'])} rows")
+        else:
+            add_run(db, data.get("workload", "run"), data)
+            print("added 1 row")
+    elif cmd == "list":
+        for r in query(db, argv[3] if len(argv) > 3 else None):
+            print(r)
+    elif cmd == "best":
+        rows = db.execute(
+            f"SELECT ts, {argv[4]} FROM runs WHERE workload = ? "
+            f"ORDER BY {argv[4]} DESC LIMIT 1", (argv[3],)).fetchall()
+        print(rows[0] if rows else "no rows")
+    else:
+        print(__doc__)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
