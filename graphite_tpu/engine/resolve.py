@@ -2060,7 +2060,55 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
     else:
         lb_excl = jnp.full_like(lb, INF)       # no other tiles exist
     lb_excl = jnp.minimum(lb_excl, store_min)
-    woke_nc = dense.binsum(oh_c, wake & ~w_bc, 1) > 0
+    # ---- store-side wakes (ThreadScheduler): descheduled waiters must
+    # be woken directly — a waiter and its signaler placed on the same
+    # tile alternate one seat and may NEVER be co-seated, so seat-only
+    # matching would hang them (the store_min bound above stops the
+    # token from being falsely lost, but cannot deliver the wake).
+    # Broadcasts wake every eligible stored waiter; a signal falls back
+    # to the earliest stored waiter only when no seated one matched.
+    woke_seat_nc = dense.binsum(oh_c, wake & ~w_bc, 1) > 0
+    if state.sched_enabled:
+        S = state.strm_cursor.shape[0]
+        s_tile = (jnp.arange(S, dtype=jnp.int32) % T)
+        seated_s = jnp.zeros(S, dtype=bool).at[state.seat_stream].set(True)
+        s_is_cw = (state.strm_pend_kind == PEND_COND) & ~seated_s \
+            & ~state.strm_done
+        s_cid = jnp.clip(state.strm_pend_addr, 0, NC - 1).astype(jnp.int32)
+        s_t = state.strm_pend_issue
+        s_wt = tok_time_nc[s_cid]
+        s_has = has_tok_nc[s_cid]
+        s_bc = tok_bc_nc[s_cid]
+        if params.cond_replay:
+            s_elig = s_is_cw & s_has
+            s_wake_at = jnp.maximum(s_t, s_wt)
+        else:
+            s_elig = s_is_cw & s_has & (s_t <= s_wt)
+            s_wake_at = s_wt
+        # Signal fallback: earliest eligible stored waiter per cond,
+        # only for conds whose signal woke no seated waiter.
+        sBIG = jnp.int64(2**62)
+        skey = jnp.clip(s_t, 0, jnp.int64(2**40)) * S \
+            + jnp.arange(S, dtype=jnp.int64)
+        stbl = jnp.full((NC,), sBIG, jnp.int64).at[
+            jnp.where(s_elig, s_cid, NC)].min(skey, mode="drop")
+        s_first = s_elig & (stbl[s_cid] == skey)
+        s_wake = jnp.where(s_bc, s_elig,
+                           s_first & ~woke_seat_nc[s_cid])
+        to_mcp_s = to_mcp[s_tile]
+        state = state._replace(
+            strm_pend_kind=jnp.where(s_wake, PEND_MUTEX,
+                                     state.strm_pend_kind),
+            strm_pend_addr=jnp.where(
+                s_wake, state.strm_pend_aux.astype(jnp.int64),
+                state.strm_pend_addr),
+            strm_pend_issue=jnp.where(s_wake, s_wake_at - to_mcp_s,
+                                      state.strm_pend_issue))
+        woke_store_nc = jnp.zeros((NC,), dtype=bool).at[
+            jnp.where(s_wake & ~s_bc, s_cid, NC)].set(True, mode="drop")
+        woke_nc = woke_seat_nc | woke_store_nc
+    else:
+        woke_nc = woke_seat_nc
     woke_mine = _sel(oh_c, woke_nc.astype(jnp.int32)) > 0
     if params.cond_replay:
         # A token is lost only when no waiter for its cond is parked AND

@@ -179,33 +179,35 @@ def annotate_raw(binary: str, trace_in: str, trace_out=None, costs=None):
             (n,) = struct.unpack("<I", f.read(4))
             per_tile.append(np.frombuffer(
                 f.read(n * _REC.itemsize), dtype=_REC).copy())
-    if not table:
-        # No probe sites decoded (binary built without coverage, or a
-        # foreign guard ABI) — keep the runtime estimates.
-        print("annotate_trace: no static blocks decoded; keeping runtime "
-              "estimates", file=sys.stderr)
-        return 0, 0
     # Vectorized rewrite: sorted block-table lookup per COMPUTE pc
     # (captures emit one COMPUTE per executed block — 10^7+ events for a
-    # real benchmark; a per-event Python loop would cost minutes).
+    # real benchmark; a per-event Python loop would cost minutes).  An
+    # empty table (binary built without coverage, foreign guard ABI)
+    # matches nothing and the trace passes through unmodified —
+    # trace_out is still written either way.
+    if not table:
+        print("annotate_trace: no static blocks decoded; keeping runtime "
+              "estimates", file=sys.stderr)
     keys = np.array(sorted(table.keys()), dtype=np.int64)
-    vals = np.array([table[k] for k in keys], dtype=np.int64)  # [K, 2]
+    vals = (np.array([table[k] for k in keys], dtype=np.int64)
+            if table else np.zeros((0, 2), dtype=np.int64))
     total = hits = 0
     for rec in per_tile:
         comp = rec["op"] == int(EventOp.COMPUTE)
         pcs = rec["addr"][comp].astype(np.int64)
         total += len(pcs)
-        idx = np.searchsorted(keys, pcs)
-        ok = (idx < len(keys))
-        idx = np.minimum(idx, max(len(keys) - 1, 0))
-        ok &= keys[idx] == pcs
-        hits += int(ok.sum())
-        ic = rec["arg2"][comp].copy()
-        cost = rec["arg"][comp].copy()
-        ic[ok] = vals[idx[ok], 0]
-        cost[ok] = vals[idx[ok], 1]
-        rec["arg2"][comp] = ic
-        rec["arg"][comp] = cost
+        if len(keys):
+            idx = np.searchsorted(keys, pcs)
+            ok = idx < len(keys)
+            idx = np.minimum(idx, len(keys) - 1)
+            ok &= keys[idx] == pcs
+            hits += int(ok.sum())
+            ic = rec["arg2"][comp].copy()
+            cost = rec["arg"][comp].copy()
+            ic[ok] = vals[idx[ok], 0]
+            cost[ok] = vals[idx[ok], 1]
+            rec["arg2"][comp] = ic
+            rec["arg"][comp] = cost
     with open(trace_out, "wb") as f:
         f.write(MAGIC)
         f.write(struct.pack("<I", num_tiles))

@@ -91,7 +91,12 @@ def main() -> int:
     latest = os.path.join(args.out, "latest")
     if os.path.islink(latest):
         os.unlink(latest)
-    if not os.path.exists(latest):
+    if os.path.exists(latest):
+        # A real file/dir in the symlink's place would silently pin
+        # 'latest' to stale results.
+        print(f"warning: {latest} is not a symlink; leaving it alone",
+              file=sys.stderr)
+    else:
         os.symlink(stamp, latest)
 
     matrix = _QUICK if args.quick else _FULL
@@ -122,8 +127,9 @@ def main() -> int:
     db = open_db(os.path.join(args.out, "results.db"))
     for r in rows:
         add_run(db, r["cell"], r)
-    print(f"{len(rows) - failed}/{len(matrix)} cells passed; results in "
-          f"{outdir}", flush=True)
+    passed = sum(1 for r in rows if r["all_done"])
+    print(f"{passed}/{len(matrix)} cells passed; results in {outdir}",
+          flush=True)
     return 1 if failed else 0
 
 

@@ -95,9 +95,17 @@ def main(argv) -> int:
         for r in query(db, argv[3] if len(argv) > 3 else None):
             print(r)
     elif cmd == "best":
+        metric = argv[4]
+        allowed = {"mips": "DESC", "events_per_sec": "DESC",
+                   "host_seconds": "ASC", "completion_time_ns": "ASC"}
+        if metric not in allowed:
+            print(f"unknown metric {metric!r} (valid: "
+                  f"{', '.join(sorted(allowed))})", file=sys.stderr)
+            return 2
         rows = db.execute(
-            f"SELECT ts, {argv[4]} FROM runs WHERE workload = ? "
-            f"ORDER BY {argv[4]} DESC LIMIT 1", (argv[3],)).fetchall()
+            f"SELECT ts, {metric} FROM runs WHERE workload = ? "
+            f"ORDER BY {metric} {allowed[metric]} LIMIT 1",
+            (argv[3],)).fetchall()
         print(rows[0] if rows else "no rows")
     else:
         print(__doc__)
