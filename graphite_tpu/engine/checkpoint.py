@@ -24,7 +24,8 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 18  # v18: iocoom register scoreboard (reg_ready);
+_SCHEMA_VERSION = 19  # v19: VMManager accounting scalars (vm_*);
+#   v18: iocoom register scoreboard (reg_ready);
 #   v17: ThreadScheduler seats + stream store (strm_*,
 #       seat_*; stream-indexed spawned_at/done_at);
 #   v16: dram_qacc moment accumulators (m_g_1 queue model);

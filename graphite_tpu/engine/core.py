@@ -47,7 +47,7 @@ from graphite_tpu.engine.state import (
     PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
     PEND_START, SimState, TraceArrays)
 from graphite_tpu.events.schema import ICACHE_BYTES_PER_INSTRUCTION
-from graphite_tpu.isa import DVFSModule, EventOp
+from graphite_tpu.isa import DVFSModule, EventOp, SyscallClass
 from graphite_tpu import params as params_mod
 from graphite_tpu.params import SimParams
 
@@ -182,8 +182,14 @@ def _block_retire(params: SimParams, st: SimState,
         # Register-annotated events (scoreboard operands in arg2's high
         # bits) need the complex slot's RAW floors/writes — decline them
         # here.  Unannotated traces (arg2 high bits zero) are untouched.
+        # Heterogeneous model_list: only iocoom tiles decline (simple
+        # tiles ignore register annotations, as the reference's
+        # SimpleCoreModel does).
         annotated = (is_comp & ((arg2 >> 20) != 0)) \
             | (is_rd & (((arg2 >> 8) & 31) != 0))
+        if params.core.mixed:
+            annotated = annotated \
+                & jnp.asarray(params.core.iocoom_mask)[:, None]
         mem_simple = mem_simple & ~annotated
         comp_simple = comp_simple & ~annotated
     fill_d = mem_l2                           # L1D fill from local L2 hit
@@ -213,6 +219,12 @@ def _block_retire(params: SimParams, st: SimState,
         drain_ev = is_spawn | is_sync \
             | (is_br if not params.core.speculative_loads
                else jnp.zeros_like(is_br))
+        if params.core.mixed:
+            # Simple tiles have no LQ/SQ to drain (their rings stay 0,
+            # so drain_t is harmless, but the branch/sync drain
+            # semantics are iocoom-only).
+            drain_ev = drain_ev \
+                & jnp.asarray(params.core.iocoom_mask)[:, None]
     else:
         drain_ev = jnp.zeros_like(is_br)
 
@@ -291,11 +303,21 @@ def _block_retire(params: SimParams, st: SimState,
     # per round while the flag is off races them past their own ENABLE
     # point relative to other tiles (test_roi_gates_counters_and_time).
     br_abs = iocoom and not params.core.speculative_loads
+    if br_abs and params.core.mixed:
+        # Branches drain only on iocoom tiles; simple tiles retire them
+        # in the relative (max-plus) class as always.
+        _iot_w = jnp.asarray(params.core.iocoom_mask)[:, None]
+        br_rel = is_br & ~_iot_w
+        br_drain = is_br & _iot_w
+    elif br_abs:
+        br_rel = jnp.zeros_like(is_br)
+        br_drain = is_br
+    else:
+        br_rel = is_br
+        br_drain = jnp.zeros_like(is_br)
     base_ok = valid_ev & ~hazard & en
-    ok_rel = (comp_simple | mem_simple
-              | (jnp.zeros_like(is_br) if br_abs else is_br)) & base_ok
-    ok_abs = (is_stall | is_sync | is_spawn
-              | (is_br if br_abs else jnp.zeros_like(is_br))) & base_ok
+    ok_rel = (comp_simple | mem_simple | br_rel) & base_ok
+    ok_abs = (is_stall | is_sync | is_spawn | br_drain) & base_ok
     ok_bank = (mem_bank | comp_bank) & base_ok
     ok = ok_rel | ok_abs | ok_bank            # retire-capable (BP masking)
 
@@ -688,6 +710,8 @@ def _complex_slot(params: SimParams, state: SimState,
                     | (op == EventOp.DONE))
         if not params.core.speculative_loads:
             drain_op = drain_op | (op == EventOp.BRANCH)
+        if params.core.mixed:
+            drain_op = drain_op & jnp.asarray(params.core.iocoom_mask)
         clk = jnp.where(drain_op, jnp.maximum(st.clock, drain_t),
                         st.clock)
         # Register scoreboard RAW floor (reference
@@ -696,6 +720,8 @@ def _complex_slot(params: SimParams, state: SimState,
         # source register stalls until that register's ready time.
         sreg = (arg2 >> 20) & 31          # src reg + 1, 0 = none
         has_sreg = (op == EventOp.COMPUTE) & (sreg > 0)
+        if params.core.mixed:
+            has_sreg = has_sreg & jnp.asarray(params.core.iocoom_mask)
         rr = st.reg_ready[jnp.maximum(sreg - 1, 0), rows]
         clk = jnp.where(has_sreg, jnp.maximum(clk, rr), clk)
     else:
@@ -1165,8 +1191,11 @@ def _complex_slot(params: SimParams, state: SimState,
         wreg = jnp.where(is_comp & (dregc > 0), dregc,
                          jnp.where((mem_l1 | mem_l2) & is_rd
                                    & (mdreg > 0), mdreg, 0))
+        sb_write = (wreg > 0) & active
+        if params.core.mixed:
+            sb_write = sb_write & jnp.asarray(params.core.iocoom_mask)
         st = st._replace(reg_ready=st.reg_ready.at[
-            jnp.where((wreg > 0) & active, wreg - 1, NREG),
+            jnp.where(sb_write, wreg - 1, NREG),
             rows].max(new_clock, mode="drop"))
     st = st._replace(
         clock=new_clock,
@@ -1192,6 +1221,16 @@ def _complex_slot(params: SimParams, state: SimState,
         round_ctr=st.round_ctr + 1,
         ctr_complex=st.ctr_complex + 1,
         counters=c,
+        # VMManager accounting (reference vm_manager.cc; engine/vm.py):
+        # mmap/munmap lengths and the requested break ride the SYSCALL
+        # event's addr field.  Functional, so not ROI-gated — the
+        # reference executes memory-management syscalls regardless.
+        vm_mmap_bytes=st.vm_mmap_bytes + jnp.sum(jnp.where(
+            is_sysc & (arg == int(SyscallClass.MMAP)), addr, 0)),
+        vm_munmap_bytes=st.vm_munmap_bytes + jnp.sum(jnp.where(
+            is_sysc & (arg == int(SyscallClass.MUNMAP)), addr, 0)),
+        vm_brk=jnp.maximum(st.vm_brk, jnp.max(jnp.where(
+            is_sysc & (arg == int(SyscallClass.BRK)), addr, 0))),
     )
     if P > 0:
         st = st._replace(

@@ -278,3 +278,32 @@ def megastep(params: SimParams, state: SimState,
 
     state, _ = jax.lax.scan(body, state, None, length=params.quanta_per_step)
     return state
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def megarun(params: SimParams, state: SimState, trace: TraceArrays,
+            max_quanta) -> SimState:
+    """Run quantum steps ON DEVICE until the simulation completes or
+    ``max_quanta`` quanta elapse — one host dispatch per polling window.
+
+    ``megastep`` pays one host->device dispatch per ``quanta_per_step``
+    quanta; under a tunneled accelerator each dispatch is a network
+    round trip, and at small tile counts those round trips — not device
+    compute — dominated bench wall-clock (r5 profile).  The body here is
+    the SAME ``quantum_step``, so timing semantics are bit-identical;
+    the while_loop just moves the step loop and the done check across
+    the dispatch boundary.  ``max_quanta`` is a TRACED scalar so every
+    window size shares one compiled program (the warm-up run must warm
+    the real program).
+    """
+    start = state.ctr_quantum
+    budget = jnp.asarray(max_quanta, jnp.int64)
+
+    def cond(st: SimState):
+        return (~st.all_done()) \
+            & ((st.ctr_quantum - start) < budget)
+
+    def body(st: SimState) -> SimState:
+        return quantum_step(params, st, trace)
+
+    return jax.lax.while_loop(cond, body, state)

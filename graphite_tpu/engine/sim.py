@@ -20,7 +20,9 @@ import jax
 import numpy as np
 
 from graphite_tpu.config import Config
-from graphite_tpu.engine.quantum import megastep
+from graphite_tpu.engine.quantum import megarun, megastep  # noqa: F401
+# (megastep stays exported: the sharded mesh path, the multi-host dryrun,
+# and __graft_entry__ drive it directly)
 from graphite_tpu.engine.state import SimState, TraceArrays, make_state
 from graphite_tpu.events.schema import Trace
 from graphite_tpu.params import SimParams
@@ -49,6 +51,9 @@ class SimSummary:
             f: np.asarray(getattr(state.counters, f))
             for f in state.counters._fields
         }
+        self.vm_brk = int(state.vm_brk)
+        self.vm_mmap_bytes = int(state.vm_mmap_bytes)
+        self.vm_munmap_bytes = int(state.vm_munmap_bytes)
 
     # ------------------------------------------------------------ metrics
 
@@ -145,7 +150,20 @@ class SimSummary:
         }
         if self.params.enable_power_modeling:
             out["energy"] = self.energy().to_dict()
+        vm_sec = self.vm_summary()
+        if vm_sec is not None:
+            out["vm"] = vm_sec
         return out
+
+    def vm_summary(self):
+        """Simulated address-space accounting (engine/vm.summarize;
+        reference vm_manager.cc segments) — None when the trace made no
+        memory-management syscalls."""
+        from graphite_tpu.engine import vm as vmmod
+        return vmmod.summarize(
+            self.params.num_tiles, self.params.stack_base,
+            self.params.stack_size_per_core, self.vm_brk,
+            self.vm_mmap_bytes, self.vm_munmap_bytes)
 
     def render(self) -> str:
         c = self.counters
@@ -212,6 +230,16 @@ class SimSummary:
         row("Syscalls", agg["syscalls"])
         row("Syscall Time (in ns, total)",
             f"{ps_to_ns(agg['syscall_ps']):.1f}")
+        vm_sec = self.vm_summary()
+        if vm_sec is not None:
+            lines.append("[vm]")
+            row("Data Segment (brk) Bytes", vm_sec["data_segment_bytes"])
+            row("Dynamic Segment (mmap) Bytes", vm_sec["mmap_bytes"])
+            row("Unmapped (munmap) Bytes", vm_sec["munmap_bytes"])
+            row("Stack Segment Bytes", vm_sec["stack_segment_bytes"])
+            if vm_sec["brk_overflow"] or vm_sec["dynamic_overflow"]:
+                row("SEGMENT OVERFLOW", "brk" if vm_sec["brk_overflow"]
+                    else "dynamic")
         lines.append("[stalls]")
         row("Memory Stall (in ns, total)", f"{ps_to_ns(agg['mem_stall_ps']):.1f}")
         row("Sync Stall (in ns, total)", f"{ps_to_ns(agg['sync_stall_ps']):.1f}")
@@ -276,15 +304,25 @@ class Simulator:
                 self.params.protocol)
         t0 = time.perf_counter()
         last_progress = None
+        qps = self.params.quanta_per_step
         while True:
-            for _ in range(poll_every):
-                self.state = megastep(self.params, self.state, self.trace)
-                self.steps += 1
-                if max_steps is not None and self.steps >= max_steps:
-                    break
-            done, cursor_sum, clock_sum = jax.device_get(
+            # One device dispatch per polling window: megarun loops
+            # quantum steps ON DEVICE and exits early once every stream
+            # is done — the per-megastep dispatch round trips (a network
+            # hop each under a tunneled accelerator) used to dominate
+            # small-T wall clock.
+            window = poll_every if max_steps is None \
+                else max(min(poll_every, max_steps - self.steps), 0)
+            if window == 0:
+                break
+            self.state = megarun(self.params, self.state, self.trace,
+                                 window * qps)
+            done, cursor_sum, clock_sum, quanta = jax.device_get(
                 (self.state.all_done(), self.state.cursor.sum(),
-                 self.state.clock.sum()))
+                 self.state.clock.sum(), self.state.ctr_quantum))
+            # Megastep-equivalent step count (reporting + max_steps
+            # budget), from the quanta the device actually ran.
+            self.steps = -(-int(quanta) // qps)
             if bool(done):
                 break
             if max_steps is not None and self.steps >= max_steps:

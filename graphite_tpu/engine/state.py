@@ -407,6 +407,15 @@ class SimState(NamedTuple):
     ctr_resolve: jnp.ndarray   # [] int64
     ctr_quantum: jnp.ndarray   # [] int64
 
+    # -- VMManager accounting (reference: vm_manager.cc bump segments).
+    # SYSCALL events carry the payload in the event's addr field
+    # (mmap/munmap: length; brk: the requested data-segment size — the
+    # delta over the program's initial break); the complex slot
+    # folds them in and engine/vm.summarize renders the segment layout.
+    vm_brk: jnp.ndarray          # [] int64 peak requested data-segment size
+    vm_mmap_bytes: jnp.ndarray   # [] int64 total bytes mmap'd
+    vm_munmap_bytes: jnp.ndarray  # [] int64 total bytes munmap'd
+
     # -- miss-type classification filters ([cache]/track_miss_types,
     # reference cache.h:45-49 cold/capacity/sharing counters).  Per-tile
     # direct-mapped line tables (fmix-hashed, last-writer-wins — a
@@ -612,6 +621,9 @@ def make_state(params: SimParams,
         ctr_conflict=jnp.int64(0),
         ctr_resolve=jnp.int64(0),
         ctr_quantum=jnp.int64(0),
+        vm_brk=jnp.int64(0),
+        vm_mmap_bytes=jnp.int64(0),
+        vm_munmap_bytes=jnp.int64(0),
         seen_filter=jnp.zeros(
             (T, MISS_FILTER_SLOTS) if params.track_miss_types else (1, 1),
             dtype=jnp.int32),

@@ -241,11 +241,16 @@ class TraceBuilder:
     def recv(self, tile: int, src: int, size: int = 8) -> None:
         self._emit(tile, EventOp.RECV, 0, size, src)
 
-    def syscall(self, tile: int, syscall_class, nbytes: int = 0) -> None:
+    def syscall(self, tile: int, syscall_class, nbytes: int = 0,
+                vm_arg: int = 0) -> None:
         """Marshalled system call served by the MCP's syscall server
         (reference: syscall_model.cc -> syscall_server.cc:43-130);
-        ``nbytes`` = marshalled argument/result payload."""
-        self._emit(tile, EventOp.SYSCALL, 0, int(syscall_class), nbytes)
+        ``nbytes`` = marshalled argument/result payload.  ``vm_arg``
+        carries the VMManager payload in the addr field (mmap/munmap:
+        length; brk: the requested data-segment size, i.e. the delta
+        over the initial break — vm_manager.cc, engine/vm.py)."""
+        self._emit(tile, EventOp.SYSCALL, vm_arg, int(syscall_class),
+                   nbytes)
 
     def barrier(self, tile: int, barrier_id: int, participants: int) -> None:
         self._emit(tile, EventOp.BARRIER_WAIT, 0, barrier_id, participants)

@@ -38,6 +38,8 @@
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <sys/mman.h>
+#include <unistd.h>
 
 #include <map>
 #include <mutex>
@@ -78,6 +80,10 @@ int g_instr_per_call = 6;
 bool g_cov_active = false;
 int g_instr_per_block = 5;
 int g_branch_every = 1;
+/* Initial program break, captured once in the (single-threaded)
+ * constructor — brk events record deltas against it (see __wrap_brk);
+ * a lazy per-call init would race between instrumented threads. */
+long long g_initial_break = 0;
 
 thread_local long tl_icount = 0;
 thread_local uint64_t tl_pc = 0x400000;
@@ -154,6 +160,7 @@ int env_int(const char *name, int dflt) {
 __attribute__((constructor)) void capture_ctor() {
     g_instr_per_access = env_int("CARBON_TSAN_INSTR_PER_ACCESS", 2);
     g_instr_per_call = env_int("CARBON_TSAN_INSTR_PER_CALL", 6);
+    g_initial_break = (long long)(uintptr_t)sbrk(0);
     CarbonStartSim(env_int("CARBON_MAX_TILES", 64));
 }
 
@@ -324,12 +331,20 @@ int __wrap_pthread_barrier_wait(pthread_barrier_t *b) {
  * (e.g. printf's internal write) bypass --wrap — like the reference's
  * Pin tool, only application-level I/O is modeled. ---- */
 
-static void sys_event(int cls, long nbytes) {
+/* Memory-management syscalls additionally carry the VMManager payload
+ * in the event's addr field (mmap/munmap: length; brk: the requested
+ * data-segment size) — the engine's simulated-address-space accounting
+ * (graphite_tpu/engine/vm.py; reference vm_manager.cc). */
+static void sys_event_vm(int cls, long nbytes, long long vm_arg) {
     if (tl_inside || !CarbonCaptureActive()) return;
     Reent r;
     flush_compute();
-    CarbonEmitEvent(CARBON_EV_SYSCALL, 0, cls,
+    CarbonEmitEvent(CARBON_EV_SYSCALL, vm_arg, cls,
                     (int)(nbytes < 0 ? 0 : nbytes));
+}
+
+static void sys_event(int cls, long nbytes) {
+    sys_event_vm(cls, nbytes, 0);
 }
 
 long __wrap_read(int fd, void *buf, unsigned long n) {
@@ -380,19 +395,31 @@ int __wrap_access(const char *path, int mode) {
 void *__wrap_mmap(void *addr, unsigned long len, int prot, int flags,
                   int fd, long off) {
     void *r = __real_mmap(addr, len, prot, flags, fd, off);
-    sys_event(CARBON_SYS_MMAP, 0);
+    /* Account only obtained memory: a failed probe mmap must not
+     * inflate the simulated footprint. */
+    sys_event_vm(CARBON_SYS_MMAP, 0,
+                 r == MAP_FAILED ? 0 : (long long)len);
     return r;
 }
 
 int __wrap_munmap(void *addr, unsigned long len) {
     int r = __real_munmap(addr, len);
-    sys_event(CARBON_SYS_MUNMAP, 0);
+    sys_event_vm(CARBON_SYS_MUNMAP, 0, (long long)len);
     return r;
 }
 
 int __wrap_brk(void *addr) {
+    /* The payload is the requested break as a DELTA over the first
+     * observed break (i.e. the requested data-segment size) — a raw
+     * host address would be meaningless against the engine's canonical
+     * simulated layout (PIE breaks sit at ~0x5555xxxxxxxx, far above
+     * the simulated stack base; engine/vm.py seeds the simulated data
+     * segment at a fixed START_DATA instead of the reference's host
+     * sbrk(0), vm_manager.cc:9). */
     int r = __real_brk(addr);
-    sys_event(CARBON_SYS_BRK, 0);
+    long long delta = (long long)(uintptr_t)addr - g_initial_break;
+    sys_event_vm(CARBON_SYS_BRK, 0,
+                 (r == 0 && delta > 0) ? delta : 0);
     return r;
 }
 

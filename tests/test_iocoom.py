@@ -178,3 +178,54 @@ def test_scoreboard_hit_load_feeds_register():
     p = make_params("iocoom")
     s = _run(p, tb.build())
     assert bool(np.asarray(s.state.done).all())
+
+
+def _mixed_params(order, tiles=2, **overrides):
+    """order: e.g. '<1,simple,...>, <1,iocoom,...>' per-tile core types."""
+    cfg = load_config()
+    cfg.set("general/total_cores", tiles)
+    cfg.set("tile/model_list", ", ".join(
+        f"<1,{c},T1,T1,T1>" for c in order))
+    # Decouple the tiles: no DRAM queueing, so each tile's timing matches
+    # its homogeneous counterpart exactly.
+    cfg.set("dram/queue_model/enabled", "false")
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return SimParams.from_config(cfg)
+
+
+def _two_tile_miss_compute_trace(n_loads=4, cost=200):
+    """BOTH tiles run the same miss+compute sequence on private lines."""
+    tb = TraceBuilder(2)
+    for t in range(2):
+        base = synth.PRIVATE_BASE + t * 0x10000
+        for i in range(n_loads):
+            tb.read(t, base + 64 * i, 8)
+            tb.compute(t, cost_cycles=cost, icount=1)
+    return tb.build()
+
+
+def test_heterogeneous_tiles_run_their_own_model():
+    """A mixed <simple, iocoom> run gives each tile EXACTLY its
+    homogeneous model's timing (tiles decoupled: private lines, no DRAM
+    queue) — reference [tile]/model_list, carbon_sim.cfg:158-176."""
+    trace = _two_tile_miss_compute_trace()
+    t_simple = np.asarray(_run(
+        make_params("simple", **{"dram/queue_model/enabled": "false"}),
+        trace).state.clock)
+    t_ioc = np.asarray(_run(
+        make_params("iocoom", **{"dram/queue_model/enabled": "false"}),
+        trace).state.clock)
+    mixed = np.asarray(_run(
+        _mixed_params(("simple", "iocoom")), trace).state.clock)
+    # tile 0 is simple, tile 1 is iocoom; iocoom hides miss latency so
+    # the two differ, and each matches its homogeneous run's tile.
+    assert t_ioc[1] < t_simple[1]
+    assert mixed[0] == t_simple[0]
+    assert mixed[1] == t_ioc[1]
+
+    # Swapped order: masks follow the tuple order, not tile identity.
+    swapped = np.asarray(_run(
+        _mixed_params(("iocoom", "simple")), trace).state.clock)
+    assert swapped[0] == t_ioc[0]
+    assert swapped[1] == t_simple[1]

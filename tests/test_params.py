@@ -55,6 +55,41 @@ def test_parse_tile_model_list():
         parse_tile_model_list("garbage")
 
 
+def test_heterogeneous_core_types():
+    """Mixed simple/iocoom tuples fill tiles sequentially (reference
+    config.cc:365-460) and produce a per-tile iocoom mask."""
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    cfg.set("tile/model_list",
+            "<1,simple,T1,T1,T1>, <2,iocoom,T1,T1,T1>, <1,default,T1,T1,T1>")
+    p = SimParams.from_config(cfg)
+    assert p.core.model == "iocoom" and p.core.mixed
+    assert p.core.iocoom_mask == (False, True, True, False)
+
+
+def test_model_list_count_must_cover_tiles():
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    cfg.set("tile/model_list", "<2,simple,T1,T1,T1>")
+    with pytest.raises(ConfigError):
+        SimParams.from_config(cfg)
+    cfg.set("tile/model_list", "<8,simple,T1,T1,T1>")
+    with pytest.raises(ConfigError):
+        SimParams.from_config(cfg)
+    cfg.set("tile/model_list", "<two,simple,T1,T1,T1>")
+    with pytest.raises(ConfigError):
+        SimParams.from_config(cfg)
+
+
+def test_heterogeneous_cache_configs_rejected():
+    """Per-tile cache geometry mixes stay loudly unsupported."""
+    cfg = load_config()
+    cfg.set("general/total_cores", 2)
+    cfg.set("tile/model_list", "<1,simple,T1,T1,T1>, <1,simple,T1,T1,T2>")
+    with pytest.raises(ConfigError):
+        SimParams.from_config(cfg)
+
+
 def test_parse_dvfs_domains():
     d = parse_dvfs_domains("<1.0, CORE, L1_ICACHE>, <2.0, L2_CACHE>")
     assert d[0][0] == 1.0

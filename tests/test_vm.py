@@ -1,0 +1,117 @@
+"""VMManager: simulated address-space segments (reference:
+common/system/vm_manager.{h,cc} — data/stack/dynamic bump segments).
+
+Two layers under test: the host-side ``VMManager`` with the reference's
+exact brk/mmap/munmap API, and the engine's per-run accounting (SYSCALL
+events carrying the VM payload in the addr field fold into
+``SimState.vm_*``; the summary renders the segment layout)."""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.engine.vm import START_DYNAMIC, VMError, VMManager
+from graphite_tpu.events.schema import TraceBuilder
+from graphite_tpu.isa import SyscallClass
+from graphite_tpu.params import SimParams
+
+
+def test_mmap_carves_down_from_dynamic_segment():
+    vm = VMManager(num_tiles=64)
+    a1 = vm.mmap(length=4096)
+    a2 = vm.mmap(length=8192)
+    # vm_manager.cc mmap(): start_dynamic -= length, returns the new base.
+    assert a1 == START_DYNAMIC - 4096
+    assert a2 == a1 - 8192
+    assert vm.describe()["dynamic_segment_bytes"] == 4096 + 8192
+
+
+def test_brk_grows_data_segment_monotonically():
+    vm = VMManager(num_tiles=4)
+    start = vm.brk(0)                       # query form, like the syscall
+    assert start == vm.start_data
+    assert vm.brk(start + 65536) == start + 65536
+    assert vm.describe()["data_segment_bytes"] == 65536
+    with pytest.raises(VMError):
+        vm.brk(vm.start_stack + 1)          # runs into the stacks
+    with pytest.raises(VMError):
+        vm.brk(vm.start_data - 1)           # below the segment
+
+
+def test_stack_windows_are_disjoint_per_tile():
+    vm = VMManager(num_tiles=8)
+    lo0, hi0 = vm.stack_window(0)
+    lo1, hi1 = vm.stack_window(1)
+    assert lo0 == vm.stack_base and hi0 == lo1
+    assert hi1 - lo1 == vm.stack_size_per_core
+    with pytest.raises(VMError):
+        vm.stack_window(8)
+
+
+def test_munmap_is_accounting_only():
+    vm = VMManager(num_tiles=2)
+    a = vm.mmap(length=4096)
+    assert vm.munmap(a, 4096) == 0
+    # The reference ignores munmap ("Ignore for now"): the dynamic
+    # segment does not shrink, only the counter moves.
+    assert vm.describe()["dynamic_segment_bytes"] == 4096
+    assert vm.describe()["munmap_bytes"] == 4096
+    with pytest.raises(VMError):
+        vm.munmap(vm.start_dynamic - 1, 64)
+
+
+def test_dynamic_segment_exhaustion_is_loud():
+    vm = VMManager(num_tiles=1)
+    with pytest.raises(VMError):
+        vm.mmap(length=START_DYNAMIC)
+
+
+def test_engine_accounts_vm_syscalls():
+    """mmap/brk/munmap SYSCALL events retire through the complex slot and
+    land in the run summary's [vm] section."""
+    cfg = load_config()
+    cfg.set("general/total_cores", 2)
+    params = SimParams.from_config(cfg)
+    tb = TraceBuilder(2)
+    tb.syscall(0, SyscallClass.MMAP, nbytes=40, vm_arg=4096)
+    tb.syscall(0, SyscallClass.BRK, nbytes=8, vm_arg=1 << 16)
+    tb.syscall(1, SyscallClass.MMAP, nbytes=40, vm_arg=8192)
+    tb.syscall(1, SyscallClass.MUNMAP, nbytes=16, vm_arg=8192)
+    trace = tb.build()
+    sim = Simulator(params, trace)
+    summary = sim.run()
+    assert bool(summary.done.all())
+    vm_sec = summary.vm_summary()
+    assert vm_sec is not None
+    assert vm_sec["mmap_bytes"] == 4096 + 8192
+    assert vm_sec["munmap_bytes"] == 8192
+    assert vm_sec["data_segment_bytes"] == 1 << 16
+    assert not vm_sec["brk_overflow"] and not vm_sec["dynamic_overflow"]
+    # The rendered summary carries the [vm] section.
+    assert "[vm]" in summary.render()
+    # Syscall count includes the 4 memory-management calls.
+    assert int(summary.counters["syscalls"].sum()) == 4
+
+
+def test_vm_section_absent_without_vm_syscalls():
+    cfg = load_config()
+    cfg.set("general/total_cores", 2)
+    params = SimParams.from_config(cfg)
+    tb = TraceBuilder(2)
+    tb.compute(0, 5, 1)
+    tb.compute(1, 5, 1)
+    summary = Simulator(params, tb.build()).run()
+    assert summary.vm_summary() is None
+    assert "[vm]" not in summary.render()
+
+
+def test_stack_defaults_match_config():
+    """defaults.cfg [stack] mirrors vm.py's constants — the VMManager's
+    standalone defaults and config-driven runs must agree on the layout."""
+    from graphite_tpu.engine.vm import (DEFAULT_STACK_BASE,
+                                        DEFAULT_STACK_SIZE_PER_CORE)
+    cfg = load_config()
+    assert cfg.get_int("stack/stack_base") == DEFAULT_STACK_BASE
+    assert cfg.get_int("stack/stack_size_per_core") \
+        == DEFAULT_STACK_SIZE_PER_CORE
