@@ -24,11 +24,18 @@ Honesty rules (VERDICT r2 "what's weak" #2-3):
 
 Compile time of the fused step is excluded (one throwaway warm-up run),
 matching how the reference's numbers exclude Pin instrumentation warm-up.
+
+Telemetry: every row writes a RunReport + Chrome-trace artifact pair
+under $GRAPHITE_BENCH_TELEMETRY_DIR (default ./bench_telemetry) AS IT
+COMPLETES, so a timed-out bench (the r5 rc=124) still leaves per-row
+profiles explaining where the time went.  Set the env var to an empty
+string to disable.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -37,27 +44,75 @@ BASELINE_MIPS = 20.0
 NUM_TILES = 64
 KEYS_PER_TILE = 2048
 
+TELEMETRY_DIR = os.environ.get("GRAPHITE_BENCH_TELEMETRY_DIR",
+                               "bench_telemetry")
 
-def _run(trace_fn, num_tiles: int, max_steps=None, **overrides):
+
+class _RowSpans:
+    """Host spans scoped to one bench row (slice of the global tracer)."""
+
+    def __init__(self, tracer):
+        self._tracer = tracer
+        self._mark = tracer.mark()
+
+    @property
+    def events(self):
+        return self._tracer.since(self._mark)
+
+
+def _emit_row_telemetry(label: str, summary, row_spans):
+    """Write the row's RunReport/trace pair; returns the report path, or
+    None when disabled or the write failed (the bench row must not point
+    at a file that does not exist)."""
+    if not TELEMETRY_DIR:
+        return None
+    try:
+        paths = summary.write_telemetry(TELEMETRY_DIR, tracer=row_spans,
+                                        workload=label, prefix=label)
+        # Cumulative host-span track (capture/build/annotate phases live
+        # outside any one row); rewritten after every row so a timed-out
+        # bench still shows where the wall clock went.
+        from graphite_tpu import obs
+        from graphite_tpu.obs.export import chrome_trace
+        path = os.path.join(TELEMETRY_DIR, "bench_host_trace.json")
+        with open(path, "w") as f:
+            json.dump(chrome_trace(tracer=obs.get_tracer()), f)
+        return paths["report"]
+    except Exception as e:     # telemetry must never sink a bench row
+        print(f"telemetry write failed for {label}: {e}", file=sys.stderr)
+        return None
+
+
+def _run(trace_fn, num_tiles: int, max_steps=None, label=None, **overrides):
     import jax
 
+    from graphite_tpu import obs
     from graphite_tpu.config import load_config
     from graphite_tpu.engine.sim import Simulator
     from graphite_tpu.params import SimParams
 
+    label = label or f"run{num_tiles}"
+    row_spans = _RowSpans(obs.get_tracer())
     cfg = load_config()
     cfg.set("general/total_cores", num_tiles)
+    # NOTE: device round metrics ([telemetry]) stay OFF here — the bench
+    # must time exactly the program the BASELINE numbers were measured
+    # on (honesty rules above); the RunReport still carries counters,
+    # VM, completion time, and the host spans.  Profile a row's engine
+    # health with `graphite-tpu run --telemetry-dir` instead.
     for k, v in overrides.items():
         cfg.set(k, v)
     params = SimParams.from_config(cfg)
     trace = trace_fn(num_tiles)
 
-    warm = Simulator(params, trace)
-    warm.run(max_steps=2)
+    with obs.span(f"{label}.warmup"):
+        warm = Simulator(params, trace)
+        warm.run(max_steps=2)
 
     sim = Simulator(params, trace)
     t0 = time.perf_counter()
-    summary = sim.run(max_steps=max_steps)
+    with obs.span(f"{label}.timed_run"):
+        summary = sim.run(max_steps=max_steps)
     host_s = time.perf_counter() - t0
     d = summary.to_dict()
     events = int(sum(int(v.sum()) for k, v in summary.counters.items()
@@ -100,6 +155,9 @@ def _run(trace_fn, num_tiles: int, max_steps=None, **overrides):
         "host_s_per_Mcycle": round(
             host_s / max(d["completion_time_ns"] * 2.0 / 1e6, 1e-9), 3),
     }
+    report_path = _emit_row_telemetry(label, summary, row_spans)
+    if report_path:
+        row["telemetry"] = report_path
     return row
 
 
@@ -162,6 +220,8 @@ def _captured_row(name: str):
     import sys
     import tempfile
 
+    from graphite_tpu import obs
+
     spec = _CAPTURES[name]
     bench_root = "/root/reference/tests/benchmarks"
     macros = os.path.join(bench_root, "splash_support/c.m4.null.POSIX")
@@ -169,7 +229,7 @@ def _captured_row(name: str):
     if not os.path.exists(os.path.join(bench_root, spec["srcs"][0])):
         return None
     try:
-        with tempfile.TemporaryDirectory() as td:
+        with obs.span(f"{name}.capture"), tempfile.TemporaryDirectory() as td:
             def expand(rel, out_name):
                 out = subprocess.run(
                     [sys.executable,
@@ -204,13 +264,16 @@ def _captured_row(name: str):
             # reference's Pin decode, instruction_modeling.cc:157-348).
             sys.path.insert(0, os.path.join(repo, "tools"))
             from annotate_trace import annotate_raw
-            annotate_raw(exe, trace_path)
+            with obs.span(f"{name}.annotate"):
+                annotate_raw(exe, trace_path)
             from graphite_tpu.events.binio import load_binary_trace
-            trace = _pad_trace(load_binary_trace(trace_path))
+            with obs.span(f"{name}.trace_load"):
+                trace = _pad_trace(load_binary_trace(trace_path))
     except Exception as e:   # missing toolchain, capture failure, ...
         return {"kind": "skipped", "reason": str(e)[:200]}
     try:
         row = _run(lambda T: trace, trace.num_tiles,
+                   label=f"{name}_captured",
                    **{"general/trigger_models_within_application": "true",
                       "tpu/cond_replay": "true"})
     except Exception as e:   # device OOM on an oversize capture, ...
@@ -220,11 +283,14 @@ def _captured_row(name: str):
 
 
 def main() -> int:
+    from graphite_tpu import obs
     from graphite_tpu.events import synth
 
+    if TELEMETRY_DIR:
+        obs.enable_tracing()
     radix = lambda keys: (
         lambda T: synth.gen_radix(T, keys_per_tile=keys, radix=256))
-    main_run = _run(radix(KEYS_PER_TILE), NUM_TILES)
+    main_run = _run(radix(KEYS_PER_TILE), NUM_TILES, label="radix64")
     mips = main_run["mips"] or 0.0
     out = {
         "metric": "simulated_mips_radix64",
@@ -251,16 +317,18 @@ def main() -> int:
     # window (the trace is miss-dominated, so a wide window only pays
     # gather cost) on a completion-sized key count; this is the config
     # the north star scores (BASELINE.json).
-    safe("radix256", lambda: _run(radix(96), 256))
+    safe("radix256", lambda: _run(radix(96), 256, label="radix256"))
     safe("radix1024", lambda: _run(
         lambda T: synth.gen_radix(T, keys_per_tile=16, radix=64), 1024,
-        **{"tpu/block_events": 4}))
+        label="radix1024", **{"tpu/block_events": 4}))
     # BASELINE config 2: directory-MSI coherence stress at 256 tiles,
     # sized to complete.
     safe("fft256", lambda: _run(
-        lambda T: synth.gen_fft(T, points_per_tile=64), 256))
+        lambda T: synth.gen_fft(T, points_per_tile=64), 256,
+        label="fft256"))
     safe("lu256", lambda: _run(
-        lambda T: synth.gen_lu(T, matrix_blocks=8, block_lines=4), 256))
+        lambda T: synth.gen_lu(T, matrix_blocks=8, block_lines=4), 256,
+        label="lu256"))
     # Real workloads: reference SPLASH-2 programs captured from
     # UNMODIFIED vendored source via the TSan frontend (VERDICT r4
     # missing #9 — fft/lu/barnes as real captures, not synthetics).

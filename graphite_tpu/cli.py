@@ -29,6 +29,10 @@ def _build_parser() -> argparse.ArgumentParser:
     run.add_argument("-c", "--config", default=None)
     run.add_argument("--trace", required=True, help="trace .npz path")
     run.add_argument("-o", "--output", default=None, help="summary output path")
+    run.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                     help="enable run telemetry (host span tracing + "
+                          "[telemetry] round metrics) and write "
+                          "run_report.json + run_trace.json under DIR")
 
     par = sub.add_parser("params", help="print derived simulation parameters")
     par.add_argument("-c", "--config", default=None)
@@ -39,7 +43,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     overrides, rest = parse_overrides(argv)
     args = _build_parser().parse_args(rest)
-    cfg = load_config(args.config, overrides=overrides)
+    telemetry_dir = getattr(args, "telemetry_dir", None)
+    from graphite_tpu import obs
+    if telemetry_dir:
+        obs.enable_tracing()
+    with obs.span("config.load", path=args.config or "<defaults>"):
+        cfg = load_config(args.config, overrides=overrides)
+    if telemetry_dir and not any(p == "telemetry/enabled"
+                                 for p, _ in overrides):
+        cfg.set("telemetry/enabled", "true")
     from graphite_tpu import log as logmod
     logmod.configure(cfg)
 
@@ -49,25 +61,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.command == "run":
-        from graphite_tpu.engine.sim import run_simulation_from_trace
-
-        summary = run_simulation_from_trace(cfg, args.trace)
-        text = summary.render()
-        if args.output:
-            with open(args.output, "w") as f:
-                f.write(text)
-        else:
-            print(text)
-        # [runtime_energy_modeling/power_trace] enabled=true: write the
-        # per-interval power file beside the summary (reference
-        # carbon_sim.cfg:141-145).
-        if cfg.get_bool("runtime_energy_modeling/power_trace/enabled",
-                        False):
-            ptpath = (args.output or "sim") + ".power.csv"
-            summary.write_power_trace(ptpath)
-        return 0
+        try:
+            return _run_command(cfg, args, telemetry_dir)
+        finally:
+            if telemetry_dir:
+                # The tracer is process-global; a long-lived embedder
+                # (tests, notebooks) must not keep accumulating spans
+                # after this run's artifacts are written.
+                obs.enable_tracing(False)
 
     return 2
+
+
+def _run_command(cfg, args, telemetry_dir: Optional[str]) -> int:
+    from graphite_tpu import obs
+    from graphite_tpu.engine.sim import run_simulation_from_trace
+
+    summary = run_simulation_from_trace(cfg, args.trace)
+    text = summary.render()
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    # [runtime_energy_modeling/power_trace] enabled=true: write the
+    # per-interval power file beside the summary (reference
+    # carbon_sim.cfg:141-145).
+    if cfg.get_bool("runtime_energy_modeling/power_trace/enabled",
+                    False):
+        ptpath = (args.output or "sim") + ".power.csv"
+        summary.write_power_trace(ptpath)
+    if telemetry_dir:
+        paths = summary.write_telemetry(
+            telemetry_dir, tracer=obs.get_tracer(),
+            workload=args.trace)
+        print(f"telemetry: {paths['report']} "
+              f"{paths['trace']} (open the trace in "
+              f"https://ui.perfetto.dev or chrome://tracing)",
+              file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":

@@ -19,12 +19,15 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 19  # v19: VMManager accounting scalars (vm_*);
+_SCHEMA_VERSION = 20  # v20: [telemetry] round-metric sample arrays
+#   (tel_gauges/tel_cursor/tel_pend; zero-size when telemetry is off);
+#   v19: VMManager accounting scalars (vm_*);
 #   v18: iocoom register scoreboard (reg_ready);
 #   v17: ThreadScheduler seats + stream store (strm_*,
 #       seat_*; stream-indexed spawned_at/done_at);
@@ -92,6 +95,11 @@ def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:
                 raise ValueError(
                     f"checkpoint field {key!r} shape {a.shape} != expected "
                     f"{tmpl.shape} (params mismatch?)")
-            leaves.append(a.astype(tmpl.dtype, copy=False))
+            # Commit each leaf to a device array NOW: the engine's
+            # megarun/megastep donate their state argument, and donating
+            # a leaf that is still a host numpy view of the (mmap'd) npz
+            # is an aliasing hazard on the CPU backend (observed as
+            # nondeterministic wrong results / aborts in resumed runs).
+            leaves.append(jnp.asarray(a.astype(tmpl.dtype, copy=False)))
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     return state, steps

@@ -35,7 +35,7 @@ from graphite_tpu.engine.resolve import resolve
 from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
     PEND_JOIN, PEND_MUTEX, PEND_RECV, PEND_SEND, PEND_SH_REQ, PEND_START,
-    SimState, TraceArrays)
+    SimState, TraceArrays, sampling_enabled, stats_ring_enabled)
 from graphite_tpu.params import SimParams
 from graphite_tpu.time_base import TIME_MAX
 
@@ -61,6 +61,47 @@ def next_boundary(params: SimParams, state: SimState) -> jnp.ndarray:
                      state.boundary + q).astype(jnp.int64)
 
 
+def _tel_gauges(st: SimState) -> jnp.ndarray:
+    """Engine-health gauge rows (order: obs/metrics.TEL_SERIES) — the
+    simulator's own vitals, sampled beside the simulated machine's
+    statistics so every run doubles as a profile (PROFILE.md's
+    hand-differenced rounds/occupancy numbers, computed in-engine)."""
+    k = st.pend_kind
+    alive = ~st.done
+    mem = ((k == PEND_SH_REQ) | (k == PEND_EX_REQ)
+           | (k == PEND_IFETCH)) & alive
+    sync = ((k == PEND_BARRIER) | (k == PEND_MUTEX) | (k == PEND_COND)
+            | (k == PEND_CSIG) | (k == PEND_CBC) | (k == PEND_JOIN)
+            | (k == PEND_START)) & alive
+    msg = ((k == PEND_SEND) | (k == PEND_RECV)) & alive
+    live_clock = jnp.where(alive, st.clock, TIME_MAX)
+    any_alive = alive.any()
+    # Under the ThreadScheduler the seat arrays hold only the running
+    # subset; cumulative series must fold in the stream store (seat
+    # values patched over it, as in all_done) or a rotation would make
+    # them non-monotone.
+    if st.sched_enabled:
+        cursor_all = st.strm_cursor.at[st.seat_stream].set(st.cursor)
+        done_all = st.strm_done.at[st.seat_stream].set(st.done)
+    else:
+        cursor_all, done_all = st.cursor, st.done
+    return jnp.stack([
+        jnp.sum(cursor_all.astype(jnp.int64)),
+        jnp.sum(st.counters.icount),
+        jnp.sum(done_all, dtype=jnp.int64),
+        jnp.sum(mem, dtype=jnp.int64),
+        jnp.sum(sync, dtype=jnp.int64),
+        jnp.sum(msg, dtype=jnp.int64),
+        st.ctr_quantum,
+        st.ctr_window,
+        st.ctr_complex,
+        st.ctr_conflict,
+        st.ctr_resolve,
+        jnp.where(any_alive, jnp.min(live_clock), jnp.max(st.clock)),
+        jnp.max(st.clock),
+    ])
+
+
 def _maybe_sample(params: SimParams, state: SimState) -> SimState:
     """Record one statistics/progress sample when the quantum boundary
     crosses the sampling interval (the reference samples on barrier
@@ -75,31 +116,39 @@ def _maybe_sample(params: SimParams, state: SimState) -> SimState:
     def take(st: SimState) -> SimState:
         idx = jnp.minimum(st.stat_filled, S - 1)
         c = st.counters
-        if params.shared_l2:
-            live = jnp.sum(dword_state(st.dir_word) != 0,
+        if stats_ring_enabled(params):
+            if params.shared_l2:
+                live = jnp.sum(dword_state(st.dir_word) != 0,
+                               dtype=jnp.int64)
+            else:
+                live = jnp.sum(cachemod.meta_state(st.l2.meta) != 0,
+                               dtype=jnp.int64)
+            # cache_line_replication analog: total tracked sharer bits
+            repl = jnp.sum(jnp.bitwise_count(st.dir_sharers),
                            dtype=jnp.int64)
-        else:
-            live = jnp.sum(cachemod.meta_state(st.l2.meta) != 0,
-                           dtype=jnp.int64)
-        # cache_line_replication analog: total tracked sharer bits
-        repl = jnp.sum(jnp.bitwise_count(st.dir_sharers),
-                       dtype=jnp.int64)
-        scalars = jnp.stack([
-            jnp.sum(c.icount), jnp.sum(c.net_mem_flits),
-            jnp.sum(c.net_user_flits), jnp.sum(c.dram_reads),
-            jnp.sum(c.dram_writes), live, repl,
-            jnp.sum(c.net_link_wait_ps),
-            # Energy-bearing counters for the power trace
-            # ([runtime_energy_modeling/power_trace]; energy.power_trace
-            # diffs consecutive samples into per-interval watts).
-            jnp.sum(c.l1i_access),
-            jnp.sum(c.l1d_read) + jnp.sum(c.l1d_write),
-            jnp.sum(c.l2_access), jnp.sum(c.branches),
-            jnp.sum(c.dir_sh_req) + jnp.sum(c.dir_ex_req)
-            + jnp.sum(c.dir_invalidations)])
+            scalars = jnp.stack([
+                jnp.sum(c.icount), jnp.sum(c.net_mem_flits),
+                jnp.sum(c.net_user_flits), jnp.sum(c.dram_reads),
+                jnp.sum(c.dram_writes), live, repl,
+                jnp.sum(c.net_link_wait_ps),
+                # Energy-bearing counters for the power trace
+                # ([runtime_energy_modeling/power_trace]; energy.power_trace
+                # diffs consecutive samples into per-interval watts).
+                jnp.sum(c.l1i_access),
+                jnp.sum(c.l1d_read) + jnp.sum(c.l1d_write),
+                jnp.sum(c.l2_access), jnp.sum(c.branches),
+                jnp.sum(c.dir_sh_req) + jnp.sum(c.dir_ex_req)
+                + jnp.sum(c.dir_invalidations)])
+            st = st._replace(
+                stat_scalars=st.stat_scalars.at[:, idx].set(scalars))
+        if params.telemetry_enabled:
+            st = st._replace(
+                tel_gauges=st.tel_gauges.at[:, idx].set(
+                    _tel_gauges(st)),
+                tel_cursor=st.tel_cursor.at[idx].set(st.cursor),
+                tel_pend=st.tel_pend.at[idx].set(st.pend_kind))
         st = st._replace(
             stat_time=st.stat_time.at[idx].set(st.boundary),
-            stat_scalars=st.stat_scalars.at[:, idx].set(scalars),
             stat_filled=st.stat_filled + 1,
             stat_next=(st.boundary // interval + 1) * interval)
         if params.progress_enabled:
@@ -261,8 +310,7 @@ def quantum_step(params: SimParams, state: SimState,
 
     _, _, state = jax.lax.while_loop(
         cond, body, (jnp.int32(0), jnp.int64(-1), state))
-    if params.stats_enabled or params.progress_enabled \
-            or params.power_trace_enabled:
+    if sampling_enabled(params):
         state = _maybe_sample(params, state)
     return state
 

@@ -37,6 +37,7 @@ class SimSummary:
         self.params = params
         self.host_seconds = host_seconds
         self.steps = steps
+        self.quanta = int(state.ctr_quantum)
         self.clock = np.asarray(state.clock)
         # Per-STREAM done (== per-tile when the scheduler is off): a
         # seat only shows its currently-scheduled stream.
@@ -54,6 +55,9 @@ class SimSummary:
         self.vm_brk = int(state.vm_brk)
         self.vm_mmap_bytes = int(state.vm_mmap_bytes)
         self.vm_munmap_bytes = int(state.vm_munmap_bytes)
+        self.tel_gauges = np.asarray(state.tel_gauges)
+        self.tel_cursor = np.asarray(state.tel_cursor)
+        self.tel_pend = np.asarray(state.tel_pend)
 
     # ------------------------------------------------------------ metrics
 
@@ -75,13 +79,21 @@ class SimSummary:
                    "dram_reads", "dram_writes", "live_l2_lines",
                    "sharer_copies", "net_link_wait_ps")
 
+    @property
+    def _stats_filled(self) -> int:
+        """Samples recorded into the stat_scalars series ring — 0 when
+        only telemetry sampled (tel_* arrays have their own series; the
+        stat_scalars ring is a 1-column dummy then)."""
+        from graphite_tpu.engine.state import stats_ring_enabled
+        return self.stat_filled if stats_ring_enabled(self.params) else 0
+
     def power_trace(self) -> Dict[str, np.ndarray]:
         """Per-interval power from the sampled energy counters — the
         reference's [runtime_energy_modeling/power_trace] file
         (carbon_sim.cfg:141-145, TileEnergyMonitor)."""
         from graphite_tpu.energy import power_trace
         return power_trace(self.params, self.stat_time, self.stat_scalars,
-                           self.stat_filled)
+                           self._stats_filled)
 
     def write_power_trace(self, path: str) -> None:
         pt = self.power_trace()
@@ -95,7 +107,7 @@ class SimSummary:
         """Periodic samples taken at quantum boundaries (the reference's
         StatisticsManager trace files + progress trace, as arrays).
         Cumulative series; differentiate for rates."""
-        n = self.stat_filled
+        n = self._stats_filled
         out = {"time_ps": self.stat_time[:n]}
         for i, name in enumerate(self.STAT_SERIES):
             out[name] = self.stat_scalars[i, :n]
@@ -129,6 +141,53 @@ class SimSummary:
                 f.write(str(int(tr["time_ps"][i])) + ","
                         + ",".join(str(int(v)) for v in row) + "\n")
 
+    # -------------------------------------------------------- telemetry
+    # ([telemetry] engine-health round metrics; graphite_tpu/obs)
+
+    def telemetry_trace(self) -> Optional[Dict[str, np.ndarray]]:
+        """Sampled engine-health gauge series (obs/metrics.TEL_SERIES
+        rows over the shared quantum-boundary sample ring); None when
+        [telemetry] was disabled for the run."""
+        if not self.params.telemetry_enabled:
+            return None
+        from graphite_tpu.obs.metrics import TEL_SERIES
+        n = self.stat_filled
+        out = {"time_ps": self.stat_time[:n]}
+        for i, name in enumerate(TEL_SERIES):
+            out[name] = self.tel_gauges[i, :n]
+        return out
+
+    def tel_cursor_trace(self) -> Optional[np.ndarray]:
+        """[samples, T] per-tile trace-cursor snapshots (per-tile
+        progress in events); None when telemetry was disabled."""
+        if not self.params.telemetry_enabled:
+            return None
+        return self.tel_cursor[:self.stat_filled]
+
+    def tel_pend_trace(self) -> Optional[np.ndarray]:
+        """[samples, T] per-tile pend_kind snapshots (occupancy / stall
+        attribution); None when telemetry was disabled."""
+        if not self.params.telemetry_enabled:
+            return None
+        return self.tel_pend[:self.stat_filled]
+
+    def run_report(self, tracer=None, workload: Optional[str] = None,
+                   extra: Optional[Dict] = None) -> Dict:
+        """Machine-readable RunReport dict (obs/export.build_run_report):
+        the JSON superset of render(), plus host spans and the sampled
+        telemetry series."""
+        from graphite_tpu.obs.export import build_run_report
+        return build_run_report(self, tracer=tracer, workload=workload,
+                                extra=extra)
+
+    def write_telemetry(self, dirpath: str, tracer=None,
+                        workload: Optional[str] = None,
+                        prefix: str = "run") -> Dict[str, str]:
+        """Write the RunReport + Chrome trace-event JSON artifacts."""
+        from graphite_tpu.obs.export import write_telemetry_dir
+        return write_telemetry_dir(dirpath, self, tracer=tracer,
+                                   workload=workload, prefix=prefix)
+
     def energy(self):
         """Analytic McPAT/DSENT-shaped energy breakdown (graphite_tpu.
         energy) on the final counters at each module's current V/f."""
@@ -143,6 +202,7 @@ class SimSummary:
             "completion_time_ns": ps_to_ns(self.completion_time_ps),
             "host_seconds": self.host_seconds,
             "device_steps": self.steps,
+            "quanta": self.quanta,
             "total_instructions": self.total_instructions,
             "simulated_mips": self.simulated_mips,
             "all_done": bool(self.done.all()),
@@ -238,8 +298,10 @@ class SimSummary:
             row("Unmapped (munmap) Bytes", vm_sec["munmap_bytes"])
             row("Stack Segment Bytes", vm_sec["stack_segment_bytes"])
             if vm_sec["brk_overflow"] or vm_sec["dynamic_overflow"]:
-                row("SEGMENT OVERFLOW", "brk" if vm_sec["brk_overflow"]
-                    else "dynamic")
+                row("SEGMENT OVERFLOW", ", ".join(
+                    name for name, flag
+                    in (("brk", vm_sec["brk_overflow"]),
+                        ("dynamic", vm_sec["dynamic_overflow"])) if flag))
         lines.append("[stalls]")
         row("Memory Stall (in ns, total)", f"{ps_to_ns(agg['mem_stall_ps']):.1f}")
         row("Sync Stall (in ns, total)", f"{ps_to_ns(agg['sync_stall_ps']):.1f}")
@@ -277,8 +339,10 @@ class Simulator:
             raise ValueError(
                 f"trace has {trace.num_tiles} streams, params expect "
                 f"at least {params.num_tiles}")
+        from graphite_tpu.obs import span
         self.params = params
-        self.trace = TraceArrays.from_trace(trace)
+        with span("trace.device_upload", events=trace.ops.size):
+            self.trace = TraceArrays.from_trace(trace)
         # CAPI channel state is O(T^2); only allocate it when the trace
         # actually messages (scan once, host-side).
         from graphite_tpu.isa import EventOp
@@ -289,8 +353,9 @@ class Simulator:
             raise ValueError(
                 "CAPI SEND/RECV with multi-thread-per-core scheduling is "
                 "not supported yet (channel state is tile-addressed)")
-        self.state = make_state(params, has_capi=has_capi,
-                                num_streams=trace.num_tiles)
+        with span("state.alloc", num_tiles=params.num_tiles):
+            self.state = make_state(params, has_capi=has_capi,
+                                    num_streams=trace.num_tiles)
         self.steps = 0
         self.host_seconds = 0.0
 
@@ -298,6 +363,7 @@ class Simulator:
             poll_every: int = 8) -> SimSummary:
         """Run megasteps until every tile is DONE (or max_steps)."""
         from graphite_tpu.log import get_logger
+        from graphite_tpu.obs import span
         lg = get_logger("driver")
         lg.info("run: %d tiles, %d events/tile, protocol=%s",
                 self.params.num_tiles, self.trace.num_events,
@@ -305,6 +371,8 @@ class Simulator:
         t0 = time.perf_counter()
         last_progress = None
         qps = self.params.quanta_per_step
+        quanta = 0
+        first_dispatch = True
         while True:
             # One device dispatch per polling window: megarun loops
             # quantum steps ON DEVICE and exits early once every stream
@@ -315,11 +383,17 @@ class Simulator:
                 else max(min(poll_every, max_steps - self.steps), 0)
             if window == 0:
                 break
-            self.state = megarun(self.params, self.state, self.trace,
-                                 window * qps)
-            done, cursor_sum, clock_sum, quanta = jax.device_get(
-                (self.state.all_done(), self.state.cursor.sum(),
-                 self.state.clock.sum(), self.state.ctr_quantum))
+            # The first window pays jit trace+compile (or cache load) on
+            # top of device time; its span is named apart so compile
+            # cost is attributable in the exported host track.
+            with span("sim.compile+window" if first_dispatch
+                      else "sim.window", quanta=window * qps):
+                self.state = megarun(self.params, self.state, self.trace,
+                                     window * qps)
+                done, cursor_sum, clock_sum, quanta = jax.device_get(
+                    (self.state.all_done(), self.state.cursor.sum(),
+                     self.state.clock.sum(), self.state.ctr_quantum))
+            first_dispatch = False
             # Megastep-equivalent step count (reporting + max_steps
             # budget), from the quanta the device actually ran.
             self.steps = -(-int(quanta) // qps)
@@ -334,8 +408,10 @@ class Simulator:
                     f"(cursor_sum={cursor_sum}, clock_sum={clock_sum})")
             last_progress = progress
         self.host_seconds = time.perf_counter() - t0
-        lg.info("run finished: %d megasteps, %.2f host-s", self.steps,
-                self.host_seconds)
+        # Quanta are exact; the megastep-equivalent count would bill a
+        # partial early-exit window as a full megastep (ADVICE r5).
+        lg.info("run finished: %d quanta (%d-quanta windows), %.2f host-s",
+                int(quanta), qps, self.host_seconds)
         return self.summary()
 
     def summary(self) -> SimSummary:
@@ -362,6 +438,10 @@ def run_simulation(params: SimParams, trace: Trace,
 
 def run_simulation_from_trace(cfg: Config, trace_path: str) -> SimSummary:
     """CLI entry (graphite_tpu.cli 'run')."""
-    trace = Trace.load(trace_path)
-    params = SimParams.from_config(cfg, num_tiles=trace.num_tiles)
-    return run_simulation(params, trace)
+    from graphite_tpu.obs import span
+    with span("trace.load", path=trace_path):
+        trace = Trace.load(trace_path)
+    with span("params.resolve"):
+        params = SimParams.from_config(cfg, num_tiles=trace.num_tiles)
+    with span("sim.run", num_tiles=params.num_tiles):
+        return run_simulation(params, trace)

@@ -386,6 +386,19 @@ class SimState(NamedTuple):
     stat_icount: jnp.ndarray      # [S, T] int64 per-tile icount snapshots
     #   (the progress trace; [1, T] dummy when disabled)
 
+    # -- [telemetry] engine-health round metrics (graphite_tpu/obs):
+    # sampled in the SAME _maybe_sample take as the rings above (shared
+    # stat_filled/stat_time/stat_next bookkeeping).  Zero-size when
+    # telemetry is off — the disabled path allocates nothing and the
+    # compiled step is unchanged.
+    tel_gauges: jnp.ndarray       # [len(TEL_SERIES), S] int64 gauge rows
+    #   (row order: obs/metrics.TEL_SERIES)
+    tel_cursor: jnp.ndarray       # [S, T] int32 per-tile trace-cursor
+    #   snapshots (per-tile progress in events; SEAT-level — under the
+    #   ThreadScheduler a tile's row shows whichever stream is seated)
+    tel_pend: jnp.ndarray         # [S, T] int32 per-tile pend_kind
+    #   snapshots (per-tile occupancy / stall attribution)
+
     # -- user-network channels (CAPI; reference: common/user/capi.cc)
     # [T, T]-shaped, so allocated only when the trace actually uses CAPI
     # (zero-size dummies otherwise — see make_state(has_capi); a 1024-tile
@@ -494,6 +507,11 @@ def _dummy_cache(num_tiles: int) -> cachemod.CacheArrays:
         rr_ptr=jnp.zeros((num_tiles, 1), dtype=jnp.int32))
 
 
+def _num_tel_rows() -> int:
+    from graphite_tpu.obs.metrics import TEL_SERIES
+    return len(TEL_SERIES)
+
+
 NUM_CONDS = 64      # cond-var id space (like max_mutexes; ids clip)
 DRAM_RING_SLOTS = 8  # busy-interval history per memory controller
 MISS_FILTER_SLOTS = 1 << 14   # per-tile miss-type filter entries (2x the
@@ -501,11 +519,21 @@ MISS_FILTER_SLOTS = 1 << 14   # per-tile miss-type filter entries (2x the
 #                               outlast the cache for capacity vs cold)
 
 
+def stats_ring_enabled(params: SimParams) -> bool:
+    """Does anything consume the stat_scalars series ring (statistics /
+    progress / power trace)?  Telemetry has its own tel_* arrays."""
+    return (params.stats_enabled or params.progress_enabled
+            or params.power_trace_enabled)
+
+
+def sampling_enabled(params: SimParams) -> bool:
+    """Any consumer of the quantum-boundary sample hook configured?"""
+    return stats_ring_enabled(params) or params.telemetry_enabled
+
+
 def _nsamp(params: SimParams) -> int:
     """Sample-ring capacity: 1-row dummy when no sampling is configured."""
-    return params.max_stat_samples \
-        if (params.stats_enabled or params.progress_enabled
-            or params.power_trace_enabled) else 1
+    return params.max_stat_samples if sampling_enabled(params) else 1
 
 
 def make_state(params: SimParams,
@@ -607,10 +635,23 @@ def make_state(params: SimParams,
         stat_filled=jnp.int32(0),
         stat_next=jnp.asarray(params.stat_interval_ps, dtype=jnp.int64),
         stat_time=jnp.zeros(_nsamp(params), dtype=jnp.int64),
-        stat_scalars=jnp.zeros((13, _nsamp(params)), dtype=jnp.int64),
+        # The series ring only exists for its consumers; a telemetry-only
+        # run samples into tel_* and must not carry a dead 13 x S ring.
+        stat_scalars=jnp.zeros(
+            (13, _nsamp(params) if stats_ring_enabled(params) else 1),
+            dtype=jnp.int64),
         stat_icount=jnp.zeros(
             (_nsamp(params) if params.progress_enabled else 1, T),
             dtype=jnp.int64),
+        tel_gauges=jnp.zeros(
+            (_num_tel_rows(), _nsamp(params))
+            if params.telemetry_enabled else (0, 0), dtype=jnp.int64),
+        tel_cursor=jnp.zeros(
+            (_nsamp(params), T) if params.telemetry_enabled else (0, T),
+            dtype=jnp.int32),
+        tel_pend=jnp.zeros(
+            (_nsamp(params), T) if params.telemetry_enabled else (0, T),
+            dtype=jnp.int32),
         ch_sent=jnp.zeros((T, T) if has_capi else (0, 0), dtype=jnp.int32),
         ch_recvd=jnp.zeros((T, T) if has_capi else (0, 0), dtype=jnp.int32),
         ch_time=jnp.zeros((channel_depth, T, T) if has_capi else (0, 0, 0),

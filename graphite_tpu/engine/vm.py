@@ -6,7 +6,8 @@ segments and bump-allocates from them when the application's memory
 syscalls marshal through the MCP:
 
   * data      — grows UP from the static break via ``brk`` (vm_manager.cc
-                brk(): monotone, must stay below the stack segment);
+                brk(): sets the segment end — shrinking is accepted —
+                and must stay below the stack segment);
   * stacks    — one fixed window per tile at
                 ``stack_base + tile * stack_size_per_core``
                 ([stack] carbon_sim.cfg:113-117, thread spawn glue);
@@ -91,8 +92,9 @@ class VMManager:
 
     # -- reference API ----------------------------------------------------
     def brk(self, end_data_segment: int) -> int:
-        """Grow (or query, when 0) the data segment
-        (vm_manager.cc brk())."""
+        """Set (or query, when 0) the data segment end (vm_manager.cc
+        brk()); any end inside (start_data, start_stack) is accepted,
+        shrinking included."""
         if end_data_segment == 0:
             return self.end_data
         if end_data_segment <= self.start_data:

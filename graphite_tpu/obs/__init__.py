@@ -1,0 +1,29 @@
+"""Run telemetry: host span tracing, device round metrics, exports.
+
+The reference treats observability of the *simulated machine* as
+first-class (StatisticsManager sampling, progress trace, Log framework —
+statistics_manager.cc:41-114, pin/progress_trace.cc, common/misc/log.h);
+engine/sim.py + engine/quantum.py carry those over.  This package adds
+observability of the *simulator itself*:
+
+  * ``spans`` — nestable host-side wall-clock span tracing for the driver
+    path (config resolution, trace load, jit compile, each polling-window
+    dispatch).  Near-zero overhead when disabled: one attribute check and
+    a shared no-op context manager.
+  * ``metrics`` — the device round-metric series sampled at quantum
+    boundaries by engine/quantum._maybe_sample when [telemetry] is
+    enabled (engine-health gauges: events retired, stall-reason
+    breakdown, quanta/round counters, clock skew) plus per-tile
+    progress/occupancy snapshots.
+  * ``export`` — a machine-readable RunReport JSON (superset of the text
+    summary; consumed by bench.py / tools/results_db.py) and a Chrome
+    trace-event / Perfetto JSON merging host wall-clock span tracks with
+    per-tile simulated-time tracks.
+"""
+
+from graphite_tpu.obs.spans import (  # noqa: F401
+    SpanTracer, enable_tracing, get_tracer, span, tracing_enabled)
+from graphite_tpu.obs.metrics import TEL_SERIES  # noqa: F401
+from graphite_tpu.obs.export import (  # noqa: F401
+    RUN_REPORT_SCHEMA, build_run_report, chrome_trace,
+    write_telemetry_dir)

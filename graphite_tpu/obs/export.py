@@ -1,0 +1,200 @@
+"""RunReport + Chrome trace-event (Perfetto) export.
+
+Two artifacts per run:
+
+  * **RunReport JSON** — a machine-readable superset of the text summary
+    (engine/sim.SimSummary.render): aggregate counters, VM footprints,
+    completion time, host spans, and the sampled round-metric series.
+    Stable top-level keys; directly consumable by bench.py and
+    tools/results_db.py (which reads num_tiles/kind/mips/host_seconds/
+    completion_time_ns from any row dict).
+  * **Chrome trace-event JSON** — the ``{"traceEvents": [...]}`` format
+    chrome://tracing and https://ui.perfetto.dev load: host wall-clock
+    spans as ``X`` slices on one process track, per-tile simulated-time
+    slices (derived from the telemetry/progress samples) on another.
+    The two tracks deliberately share one timeline with different
+    units — host microseconds vs simulated microseconds — the same way
+    the reference's progress trace and host logs sit side by side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from graphite_tpu.obs.metrics import TEL_SERIES, derive_rates
+from graphite_tpu.time_base import ps_to_ns
+
+RUN_REPORT_SCHEMA = "graphite_tpu/run_report@1"
+
+HOST_PID = 1        # host driver (wall clock) process track
+DEVICE_PID = 2      # simulated device time process track
+
+# JSON-embedded per-tile matrices are capped (flagged, never silent):
+# a 1024-tile x 1024-sample cursor matrix would dominate the report.
+MAX_PER_TILE_CELLS = 65536
+# Per-tile slice tracks in the Chrome trace are capped the same way.
+MAX_TILE_TRACKS = 256
+
+
+def _jlist(a) -> list:
+    return [int(v) for v in np.asarray(a).reshape(-1)]
+
+
+def build_run_report(summary, tracer=None, workload: Optional[str] = None,
+                     extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Fold a SimSummary (+ optional SpanTracer) into the RunReport dict.
+    Everything inside is plain JSON types (round-trips json.dumps/loads)."""
+    agg = {k: int(v.sum()) for k, v in summary.counters.items()}
+    completed = bool(summary.done.all())
+    report: Dict[str, Any] = {
+        "schema": RUN_REPORT_SCHEMA,
+        "workload": workload,
+        "kind": "completed" if completed else "bounded",
+        "num_tiles": int(summary.params.num_tiles),
+        "all_done": completed,
+        "completion_time_ps": int(summary.completion_time_ps),
+        "completion_time_ns": float(ps_to_ns(summary.completion_time_ps)),
+        "host_seconds": float(summary.host_seconds),
+        "device_steps": int(summary.steps),
+        "quanta": int(summary.quanta),
+        "total_instructions": int(summary.total_instructions),
+        # MIPS only for completed runs (bench.py's honesty rule).
+        "mips": float(summary.simulated_mips) if completed else None,
+        "counters": agg,
+        "vm": summary.vm_summary(),
+        "spans": spans_to_json(tracer.events) if tracer is not None else [],
+    }
+    tel = summary.telemetry_trace()
+    if tel is not None:
+        series = {k: _jlist(v) for k, v in tel.items() if k != "time_ps"}
+        telemetry: Dict[str, Any] = {
+            "time_ps": _jlist(tel["time_ps"]),
+            "series": series,
+            "rates": {k: [float(x) for x in v]
+                      for k, v in derive_rates(tel).items()},
+        }
+        cursor = summary.tel_cursor_trace()
+        if cursor is not None:
+            if cursor.size <= MAX_PER_TILE_CELLS:
+                telemetry["per_tile_events"] = [
+                    _jlist(row) for row in cursor]
+                telemetry["per_tile_pend"] = [
+                    _jlist(row) for row in summary.tel_pend_trace()]
+            else:
+                telemetry["per_tile_omitted"] = True
+        report["telemetry"] = telemetry
+    if extra:
+        report.update(extra)
+    return report
+
+
+def spans_to_json(events) -> List[Dict[str, Any]]:
+    return [{"name": e.name, "ts_us": e.t0_ns / 1e3,
+             "dur_us": e.dur_ns / 1e3, "depth": e.depth,
+             "args": e.args or {}} for e in events]
+
+
+def _host_events(tracer) -> List[Dict[str, Any]]:
+    ev: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": HOST_PID, "tid": 0,
+        "args": {"name": "host driver (wall clock)"}}]
+    for e in tracer.events:
+        ev.append({"name": e.name, "cat": "host", "ph": "X",
+                   "ts": e.t0_ns / 1e3, "dur": e.dur_ns / 1e3,
+                   "pid": HOST_PID, "tid": 0, "args": e.args or {}})
+    return ev
+
+
+def _device_events(summary) -> List[Dict[str, Any]]:
+    """Per-tile simulated-time slices + aggregate counter tracks from the
+    sampled series (telemetry cursor snapshots when available, otherwise
+    the progress-trace icount snapshots)."""
+    tel = summary.telemetry_trace()
+    per_tile = summary.tel_cursor_trace()
+    unit = "events"
+    if per_tile is None and getattr(summary.params, "progress_enabled",
+                                    False):
+        tr = summary.stats_trace()
+        per_tile = np.asarray(tr.get("tile_icount"))
+        times = np.asarray(tr["time_ps"])
+        unit = "instr"
+    elif per_tile is not None:
+        times = np.asarray(tel["time_ps"])
+    else:
+        return []
+    if per_tile is None or len(times) == 0:
+        return []
+
+    ev: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": DEVICE_PID, "tid": 0,
+        "args": {"name": "device (simulated time)"}}]
+    n, T = per_tile.shape
+    shown = min(T, MAX_TILE_TRACKS)
+    # Prepend the t=0 origin so the first sample window is a slice too.
+    t_edges = np.concatenate([[0], times])
+    deltas = np.diff(np.concatenate(
+        [np.zeros((1, T), dtype=per_tile.dtype), per_tile], axis=0), axis=0)
+    for t in range(shown):
+        ev.append({"ph": "M", "name": "thread_name", "pid": DEVICE_PID,
+                   "tid": t, "args": {"name": f"tile {t}"}})
+        for i in range(n):
+            d = int(deltas[i, t])
+            if d <= 0:
+                continue
+            ts0, ts1 = int(t_edges[i]), int(t_edges[i + 1])
+            ev.append({"name": f"{d} {unit}", "cat": "tile", "ph": "X",
+                       "ts": ts0 / 1e6, "dur": max(ts1 - ts0, 1) / 1e6,
+                       "pid": DEVICE_PID, "tid": t, "args": {unit: d}})
+    if tel is not None:
+        for cname in ("events_retired", "tiles_done"):
+            for i in range(len(times)):
+                ev.append({"name": cname, "ph": "C", "pid": DEVICE_PID,
+                           "tid": 0, "ts": int(times[i]) / 1e6,
+                           "args": {"value": int(tel[cname][i])}})
+    if shown < T:
+        ev.append({"ph": "M", "name": "process_labels", "pid": DEVICE_PID,
+                   "tid": 0,
+                   "args": {"labels": f"showing {shown}/{T} tiles"}})
+    return ev
+
+
+def chrome_trace(summary=None, tracer=None) -> Dict[str, Any]:
+    """Build the Chrome trace-event JSON dict (loadable by Perfetto /
+    chrome://tracing): ``traceEvents`` of X/C/M phase events with
+    ts (microseconds), pid, tid."""
+    events: List[Dict[str, Any]] = []
+    if tracer is not None and tracer.events:
+        events.extend(_host_events(tracer))
+    if summary is not None:
+        events.extend(_device_events(summary))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ns",
+        "otherData": {
+            "generator": "graphite_tpu.obs",
+            "host_track_unit": "wall-clock us",
+            "device_track_unit": "simulated us",
+        },
+    }
+
+
+def write_telemetry_dir(dirpath: str, summary, tracer=None,
+                        workload: Optional[str] = None,
+                        prefix: str = "run",
+                        extra: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, str]:
+    """Write ``<prefix>_report.json`` + ``<prefix>_trace.json`` under
+    ``dirpath`` (created if needed); returns the paths."""
+    os.makedirs(dirpath, exist_ok=True)
+    report_path = os.path.join(dirpath, f"{prefix}_report.json")
+    trace_path = os.path.join(dirpath, f"{prefix}_trace.json")
+    with open(report_path, "w") as f:
+        json.dump(build_run_report(summary, tracer=tracer,
+                                   workload=workload, extra=extra), f)
+    with open(trace_path, "w") as f:
+        json.dump(chrome_trace(summary=summary, tracer=tracer), f)
+    return {"report": report_path, "trace": trace_path}

@@ -1,0 +1,58 @@
+"""Device round-metric series: names + derived views.
+
+The gauges themselves are sampled ON DEVICE at quantum boundaries by
+engine/quantum._maybe_sample (the same lax.cond hook that feeds the
+statistics/progress/power rings, so telemetry adds no fused-loop
+branches); this module is the host-side contract: the ordered series
+names matching the rows of ``SimState.tel_gauges``, and the derived
+per-window rates the exports publish.
+
+All series are CUMULATIVE except the ``stall_*`` / ``tiles_done`` /
+``clock_*`` instantaneous gauges; ``derive_rates`` differences the
+cumulative ones into per-sample-window rates (events retired per round,
+quanta per window, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+# Row order of SimState.tel_gauges ([len(TEL_SERIES), samples] int64).
+TEL_SERIES = (
+    "events_retired",     # cumulative: sum of trace cursors over all
+    #                       streams (stream store folded in under the
+    #                       ThreadScheduler, so rotations keep it monotone)
+    "instructions",       # cumulative: sum of icount counters
+    "tiles_done",         # instantaneous: streams that are DONE
+    "stall_mem",          # instantaneous: tiles parked on SH/EX/IFETCH
+    "stall_sync",         # instantaneous: tiles parked on sync objects
+    "stall_msg",          # instantaneous: tiles parked on CAPI send/recv
+    "quanta",             # cumulative: quantum steps executed
+    "rounds_window",      # cumulative: block-window retirement rounds
+    "rounds_complex",     # cumulative: complex (one-event) slots
+    "conflict_rounds",    # cumulative: directory conflict rounds
+    "resolve_calls",      # cumulative: resolve passes
+    "clock_min_ps",       # instantaneous: slowest tile clock
+    "clock_max_ps",       # instantaneous: fastest tile clock (skew = max-min)
+)
+
+_CUMULATIVE = ("events_retired", "instructions", "quanta", "rounds_window",
+               "rounds_complex", "conflict_rounds", "resolve_calls")
+
+
+def derive_rates(series: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Per-window rates from the cumulative series (length n-1 each):
+    the engine-health numbers PROFILE.md derives by hand — events retired
+    per round, rounds per quantum, quanta per sample window."""
+    out: Dict[str, np.ndarray] = {}
+    for name in _CUMULATIVE:
+        if name in series and len(series[name]) >= 2:
+            out[f"d_{name}"] = np.diff(np.asarray(series[name]))
+    if "d_events_retired" in out and "d_rounds_window" in out:
+        rounds = out["d_rounds_window"] + out.get(
+            "d_rounds_complex", np.zeros_like(out["d_rounds_window"]))
+        out["events_per_round"] = out["d_events_retired"] \
+            / np.maximum(rounds, 1)
+    return out

@@ -1,0 +1,129 @@
+"""Host-side span tracing for the simulator's own driver path.
+
+The engine's device work is profiled by the round-metric gauges
+(obs/metrics.py); everything outside the jitted step — config
+resolution, trace load/annotation, jit compile, each polling-window
+dispatch — is wall-clock host work that used to require hand-rolled
+differencing (tools/profile_round.py) to attribute.  A ``SpanTracer``
+records nestable begin/end wall-clock events from ``with span(...)``
+context managers and renders them as Chrome trace-event ``X`` slices
+(obs/export.chrome_trace).
+
+Disabled-path cost: ``span()`` on a disabled tracer is one attribute
+check returning a shared no-op context manager — no allocation, no
+clock read — so instrumentation can stay in the driver unconditionally.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+
+class SpanEvent(NamedTuple):
+    """One completed span (wall-clock, nanoseconds since tracer epoch)."""
+
+    name: str
+    t0_ns: int
+    dur_ns: int
+    depth: int
+    args: Optional[Dict[str, Any]]
+
+
+class _NullSpan:
+    """Shared reentrant no-op context manager (the disabled path)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    """Context manager recording one span into its tracer on exit."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._tracer._depth += 1
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        tr = self._tracer
+        tr._depth -= 1
+        tr.events.append(SpanEvent(
+            name=self._name, t0_ns=self._t0 - tr.epoch_ns,
+            dur_ns=t1 - self._t0, depth=tr._depth, args=self._args))
+        return False
+
+
+class SpanTracer:
+    """Collects nested wall-clock spans; exported via obs/export.
+
+    Events are appended at span EXIT (a parent therefore follows its
+    children in ``events``); ``t0_ns`` is relative to the tracer's epoch
+    so runs serialize with stable small timestamps.
+    """
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self.epoch_ns = time.perf_counter_ns()
+        self.events: List[SpanEvent] = []
+        self._depth = 0
+
+    def span(self, name: str, **args):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _LiveSpan(self, name, args or None)
+
+    def clear(self) -> None:
+        self.events = []
+        self._depth = 0
+
+    def mark(self) -> int:
+        """Cursor into ``events`` for slicing one phase's spans later."""
+        return len(self.events)
+
+    def since(self, mark: int) -> List[SpanEvent]:
+        return self.events[mark:]
+
+
+# One process-wide tracer: the driver path is single-threaded host code,
+# and a global keeps the instrumentation call sites one import away.
+_TRACER = SpanTracer(enabled=False)
+
+
+def get_tracer() -> SpanTracer:
+    return _TRACER
+
+
+def tracing_enabled() -> bool:
+    return _TRACER.enabled
+
+
+def enable_tracing(enabled: bool = True, reset: bool = True) -> SpanTracer:
+    """Switch the global tracer on/off (fresh epoch/events by default)."""
+    if reset:
+        _TRACER.clear()
+        _TRACER.epoch_ns = time.perf_counter_ns()
+    _TRACER.enabled = enabled
+    return _TRACER
+
+
+def span(name: str, **args):
+    """``with span("trace.load", path=...):`` on the global tracer."""
+    return _TRACER.span(name, **args)

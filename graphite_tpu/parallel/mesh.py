@@ -55,6 +55,8 @@ _TILE_AXIS_BY_FIELD = {
     "dram_ring_start": 1, "dram_ring_end": 1,   # [R, T]
     "link_free_mem": 1,              # [NUM_DIRS, T]
     "stat_icount": 1,                # [S, T] progress-trace snapshots
+    "tel_cursor": 1,                 # [S, T] telemetry cursor snapshots
+    "tel_pend": 1,                   # [S, T] telemetry pend_kind snapshots
 }
 
 # Fields whose tile axis is FLATTENED with a per-tile structural axis

@@ -289,6 +289,32 @@ class DramParams:
         )
 
 
+def _telemetry_interval_ns(cfg: Config) -> int:
+    """[telemetry] interval contribution to the shared sampling cadence
+    (ns; 1<<40 = no contribution).  The default 'auto' RIDES whatever
+    cadence the statistics/progress/power rings already configured —
+    turning telemetry on must not retime or early-saturate the traces
+    the user explicitly asked for — and falls back to 10 us when
+    telemetry is the only sampler.  An explicit integer participates in
+    the shared min like any other sampler."""
+    if not cfg.get_bool("telemetry/enabled", False):
+        return 1 << 40
+    if not cfg.has("telemetry/interval"):
+        val = None
+    else:
+        val = _int_or_keyword(cfg, "telemetry/interval", "auto")
+    if val is None:     # auto
+        others_on = (cfg.get_bool("statistics_trace/enabled")
+                     or cfg.get_bool("progress_trace/enabled")
+                     or cfg.get_bool(
+                         "runtime_energy_modeling/power_trace/enabled",
+                         False))
+        return (1 << 40) if others_on else 10000
+    # 0 would reach _maybe_sample's `boundary // interval` as a jitted
+    # divide-by-zero (implementation-defined on device, no exception).
+    return _positive(val, "telemetry/interval")
+
+
 def pow2_grid(n: int, tall: bool) -> Tuple[int, int]:
     """Factor a power-of-two count onto a grid (reference
     initializeClusters / sub-cluster math, network_model_atac.cc:594-630):
@@ -622,6 +648,12 @@ class SimParams:
     # energy-bearing counters every [runtime_energy_modeling] interval
     # and derive per-interval power (energy.power_trace).
     power_trace_enabled: bool
+    # [telemetry] engine-health round metrics (obs/metrics.TEL_SERIES):
+    # sampled at quantum boundaries through the SAME _maybe_sample hook
+    # as the statistics/progress/power rings (its interval folds into
+    # stat_interval_ps), so enabling telemetry adds no fused-loop
+    # branches; disabling it allocates no sample arrays.
+    telemetry_enabled: bool
 
     # TPU engine knobs
     # Window width of the block-retirement fast path (events gathered per
@@ -894,9 +926,11 @@ class SimParams:
                 (cfg.get_int("runtime_energy_modeling/interval", 1000)
                  if cfg.get_bool(
                      "runtime_energy_modeling/power_trace/enabled", False)
-                 else 1 << 40)))),
+                 else 1 << 40),
+                _telemetry_interval_ns(cfg)))),
             power_trace_enabled=cfg.get_bool(
                 "runtime_energy_modeling/power_trace/enabled", False),
+            telemetry_enabled=cfg.get_bool("telemetry/enabled", False),
             max_stat_samples=cfg.get_int("tpu/max_stat_samples", 1024),
             block_events=_block_events(cfg.get_int("tpu/block_events", 16)),
             max_events_per_quantum=cfg.get_int("tpu/max_events_per_quantum"),

@@ -404,7 +404,9 @@ void *__wrap_mmap(void *addr, unsigned long len, int prot, int flags,
 
 int __wrap_munmap(void *addr, unsigned long len) {
     int r = __real_munmap(addr, len);
-    sys_event_vm(CARBON_SYS_MUNMAP, 0, (long long)len);
+    /* Account only successful unmaps (mirror the mmap MAP_FAILED
+     * guard): a failed munmap must not inflate vm_munmap_bytes. */
+    sys_event_vm(CARBON_SYS_MUNMAP, 0, r == 0 ? (long long)len : 0);
     return r;
 }
 

@@ -1,7 +1,12 @@
 """Observability tests: ROI enable/disable, statistics sampling, progress
 trace, Log framework (reference: simulator.cc:287-301 enableModels,
-statistics_manager.cc:41-114, pin/progress_trace.cc, common/misc/log.h).
+statistics_manager.cc:41-114, pin/progress_trace.cc, common/misc/log.h),
+plus the simulator's own run telemetry (graphite_tpu/obs: host span
+tracing, device round metrics, RunReport / Chrome-trace export).
 """
+
+import functools
+import json
 
 import numpy as np
 
@@ -165,3 +170,239 @@ def test_power_trace_off_no_samples():
     trace = synth.gen_radix(num_tiles=2, keys_per_tile=16, radix=8)
     s = run_simulation(params, trace, max_steps=64)
     assert s.power_trace()["time_ns"].size == 0
+
+
+# --------------------------------------------------------- run telemetry
+# (graphite_tpu/obs: ISSUE 2 — host spans, round metrics, exports)
+
+
+def test_span_tracer_nesting_and_chrome_export():
+    from graphite_tpu.obs import SpanTracer
+    from graphite_tpu.obs.export import chrome_trace
+    tr = SpanTracer(enabled=True)
+    with tr.span("outer", phase="load"):
+        with tr.span("inner_a"):
+            pass
+        with tr.span("inner_b", n=2):
+            pass
+    assert [e.name for e in tr.events] == ["inner_a", "inner_b", "outer"]
+    by_name = {e.name: e for e in tr.events}
+    assert by_name["outer"].depth == 0
+    assert by_name["inner_a"].depth == 1
+    # children nest inside the parent's wall-clock window
+    o = by_name["outer"]
+    for child in ("inner_a", "inner_b"):
+        c = by_name[child]
+        assert o.t0_ns <= c.t0_ns
+        assert c.t0_ns + c.dur_ns <= o.t0_ns + o.dur_ns
+    # exported trace is valid Chrome trace-event JSON: X slices with
+    # ts/dur/pid/tid, round-tripping through json
+    ct = json.loads(json.dumps(chrome_trace(tracer=tr)))
+    slices = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert len(slices) == 3
+    for e in slices:
+        for key in ("name", "ts", "dur", "pid", "tid"):
+            assert key in e
+
+
+def test_span_tracer_disabled_records_nothing():
+    from graphite_tpu.obs import SpanTracer
+    tr = SpanTracer(enabled=False)
+    with tr.span("ignored"):
+        with tr.span("nested"):
+            pass
+    assert tr.events == []
+
+
+@functools.lru_cache(maxsize=1)
+def _telemetry_run():
+    """Two tiles x five 400-cycle computes (10 instructions each), with a
+    telemetry sample every 1 us quantum — small enough to hand-check."""
+    params = make_params(
+        2, **{"telemetry/enabled": "true", "telemetry/interval": 1000})
+    tb = TraceBuilder(2)
+    for t in range(2):
+        for _ in range(5):
+            tb.compute(t, 400, 10)
+    trace = tb.build()
+    return trace, run_simulation(params, trace)
+
+
+def test_round_metrics_match_hand_computed():
+    trace, s = _telemetry_run()
+    tel = s.telemetry_trace()
+    n = len(tel["time_ps"])
+    assert n >= 2
+    # samples land exactly on quantum boundaries (1 us), every quantum
+    assert np.all(tel["time_ps"] % 1_000_000 == 0)
+    assert np.all(np.diff(tel["time_ps"]) > 0)
+    assert np.array_equal(tel["quanta"], np.arange(1, n + 1))
+    # the final quantum retires everything: all trace events (5 computes
+    # + 1 DONE per tile), all 2*5*10 instructions, both tiles done
+    total_events = trace.ops.shape[0] * trace.ops.shape[1]
+    assert int(tel["events_retired"][-1]) == total_events == 12
+    assert int(tel["instructions"][-1]) == 2 * 5 * 10
+    assert int(tel["tiles_done"][-1]) == 2
+    assert int(tel["tiles_done"][0]) < 2
+    # pure-compute trace: never parked on memory/sync/messages
+    for row in ("stall_mem", "stall_sync", "stall_msg"):
+        assert np.all(tel[row] == 0)
+    # cumulative series are monotone
+    for row in ("events_retired", "instructions", "rounds_window",
+                "rounds_complex", "conflict_rounds", "resolve_calls"):
+        assert np.all(np.diff(tel[row]) >= 0)
+    # clock skew gauges bracket the completion time
+    assert np.all(tel["clock_min_ps"] <= tel["clock_max_ps"])
+    assert int(tel["clock_max_ps"][-1]) == s.completion_time_ps
+    # per-tile progress/occupancy snapshots: cursors climb to the full
+    # per-tile event count; nothing pending at sample points
+    cur = s.tel_cursor_trace()
+    assert cur.shape == (n, 2)
+    assert np.all(np.diff(cur, axis=0) >= 0)
+    assert np.array_equal(cur[-1], [6, 6])
+    assert np.all(s.tel_pend_trace() == 0)
+
+
+def test_run_report_roundtrips_with_stable_keys():
+    from graphite_tpu import obs
+    from graphite_tpu.obs.export import RUN_REPORT_SCHEMA
+    trace, s = _telemetry_run()
+    tracer = obs.SpanTracer(enabled=True)
+    with tracer.span("fake.window"):
+        pass
+    report = s.run_report(tracer=tracer, workload="hand2")
+    rt = json.loads(json.dumps(report))     # must be pure JSON types
+    assert rt == report
+    assert set(rt.keys()) == {
+        "schema", "workload", "kind", "num_tiles", "all_done",
+        "completion_time_ps", "completion_time_ns", "host_seconds",
+        "device_steps", "quanta", "total_instructions", "mips",
+        "counters", "vm", "spans", "telemetry"}
+    assert rt["schema"] == RUN_REPORT_SCHEMA
+    assert rt["kind"] == "completed" and rt["all_done"]
+    assert rt["workload"] == "hand2"
+    assert rt["counters"]["icount"] == 100
+    assert rt["completion_time_ps"] == s.completion_time_ps
+    assert rt["telemetry"]["series"]["tiles_done"][-1] == 2
+    assert rt["telemetry"]["per_tile_events"][-1] == [6, 6]
+    assert rt["spans"][0]["name"] == "fake.window"
+    # rates: per-window diffs of the cumulative series
+    assert len(rt["telemetry"]["rates"]["d_events_retired"]) \
+        == len(rt["telemetry"]["time_ps"]) - 1
+
+
+def test_chrome_trace_device_tracks():
+    from graphite_tpu.obs.export import DEVICE_PID, chrome_trace
+    _, s = _telemetry_run()
+    ct = json.loads(json.dumps(chrome_trace(summary=s)))
+    events = ct["traceEvents"]
+    slices = [e for e in events if e["ph"] == "X"]
+    assert slices, "expected per-tile X slices"
+    for e in events:
+        assert e["ph"] in ("X", "C", "M")
+        assert "pid" in e and "tid" in e
+        if e["ph"] in ("B", "E", "X"):
+            assert "ts" in e
+    # one track per tile, total sliced events == total retired events
+    assert {e["tid"] for e in slices} == {0, 1}
+    assert all(e["pid"] == DEVICE_PID for e in slices)
+    assert sum(e["args"]["events"] for e in slices) == 12
+    counters = [e for e in events if e["ph"] == "C"]
+    assert any(e["name"] == "events_retired" for e in counters)
+
+
+def test_telemetry_disabled_is_bit_identical_and_unallocated():
+    trace = synth.gen_radix(4, keys_per_tile=128, radix=16)
+    s_off = run_simulation(make_params(4), trace)
+    s_on = run_simulation(
+        make_params(4, **{"telemetry/enabled": "true",
+                          "telemetry/interval": 1000}), trace)
+    assert s_off.completion_time_ps == s_on.completion_time_ps
+    for k in s_off.counters:
+        assert np.array_equal(s_off.counters[k], s_on.counters[k]), k
+    # disabled path allocates no telemetry sample arrays at all
+    assert s_off.tel_gauges.size == 0
+    assert s_off.tel_cursor.size == 0
+    assert s_off.telemetry_trace() is None
+    assert s_off.tel_cursor_trace() is None
+
+
+def test_round_metrics_monotone_under_thread_scheduler():
+    """With more streams than tiles, seat rotation swaps cursor values
+    in and out of the stream store; the cumulative gauges must fold the
+    store in (else a rotation makes events_retired drop)."""
+    params = make_params(
+        4, **{"general/max_threads_per_core": 4,
+              "telemetry/enabled": "true", "telemetry/interval": 1000})
+    trace = synth.gen_threads_oversubscribed(num_streams=8)
+    s = run_simulation(params, trace, max_steps=256)
+    assert s.done.all()
+    tel = s.telemetry_trace()
+    assert len(tel["time_ps"]) >= 2
+    for row in ("events_retired", "instructions"):
+        assert np.all(np.diff(tel[row]) >= 0), row
+    assert int(tel["tiles_done"][-1]) == 8      # streams, not seats
+    assert int(tel["events_retired"][-1]) > 0
+
+
+def test_telemetry_auto_interval_rides_configured_cadence():
+    """Default [telemetry] interval 'auto' must not retime (or early-
+    saturate) the statistics/progress/power rings the user configured;
+    an explicit interval joins the shared min like any other ring."""
+    stats = {"statistics_trace/enabled": "true",
+             "statistics_trace/sampling_interval": 100000}
+    base = make_params(4, **stats)
+    with_tel = make_params(4, **stats, **{"telemetry/enabled": "true"})
+    assert with_tel.stat_interval_ps == base.stat_interval_ps
+    explicit = make_params(4, **stats, **{"telemetry/enabled": "true",
+                                          "telemetry/interval": 2000})
+    assert explicit.stat_interval_ps < base.stat_interval_ps
+    # telemetry alone falls back to the 10 us default
+    alone = make_params(4, **{"telemetry/enabled": "true"})
+    assert alone.stat_interval_ps == 10_000_000
+
+
+def test_telemetry_only_run_keeps_stats_ring_dummy():
+    """A telemetry-only run samples into tel_* and must not allocate or
+    pretend to have recorded the stat_scalars series ring."""
+    _, s = _telemetry_run()
+    assert s.stat_scalars.shape[1] == 1        # dummy, not max_stat_samples
+    assert s.stat_filled > 0                   # telemetry did sample
+    assert len(s.stats_trace()["time_ps"]) == 0
+    assert s.power_trace()["time_ns"].size == 0
+
+
+def test_cli_telemetry_dir_writes_artifacts(tmp_path):
+    from graphite_tpu.cli import main as cli_main
+    tb = TraceBuilder(2)
+    for t in range(2):
+        for _ in range(5):
+            tb.compute(t, 400, 10)
+    trace_path = tmp_path / "hand2.npz"
+    tb.build().save(str(trace_path))
+    out = tmp_path / "sim.out"
+
+    # without --telemetry-dir: no telemetry artifacts appear
+    rc = cli_main(["run", "--trace", str(trace_path), "-o", str(out)])
+    assert rc == 0
+    assert not list(tmp_path.glob("*_report.json"))
+
+    tel_dir = tmp_path / "tel"
+    rc = cli_main(["--telemetry/interval=1000", "run",
+                   "--trace", str(trace_path), "-o", str(out),
+                   "--telemetry-dir", str(tel_dir)])
+    assert rc == 0
+    report = json.loads((tel_dir / "run_report.json").read_text())
+    assert report["kind"] == "completed"
+    assert report["counters"]["icount"] == 100
+    assert report["telemetry"]["series"]["tiles_done"][-1] == 2
+    # the span track covers the driver path
+    names = {sp["name"] for sp in report["spans"]}
+    assert {"config.load", "trace.load", "params.resolve",
+            "sim.run"} <= names
+    assert any(n.startswith("sim.compile+window") for n in names)
+    ct = json.loads((tel_dir / "run_trace.json").read_text())
+    phases = {e["ph"] for e in ct["traceEvents"]}
+    assert "X" in phases
+    pids = {e["pid"] for e in ct["traceEvents"] if e["ph"] == "X"}
+    assert len(pids) == 2, "host + device tracks expected"

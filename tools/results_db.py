@@ -81,15 +81,16 @@ def main(argv) -> int:
         src = argv[3] if len(argv) > 3 else "-"
         text = sys.stdin.read() if src == "-" else open(src).read()
         data = json.loads(text)
-        # Accept either a bench.py top-level object (detail rows) or a
-        # single row.
+        # Accept a bench.py top-level object (detail rows), a RunReport
+        # (graphite_tpu/obs export — carries its own workload key), or a
+        # single bare row.
         if "detail" in data:
             for name, row in data["detail"].items():
                 if isinstance(row, dict):
                     add_run(db, name, row)
             print(f"added {len(data['detail'])} rows")
         else:
-            add_run(db, data.get("workload", "run"), data)
+            add_run(db, data.get("workload") or "run", data)
             print("added 1 row")
     elif cmd == "list":
         for r in query(db, argv[3] if len(argv) > 3 else None):

@@ -21,6 +21,41 @@ for f in tests/test_*.py; do
         echo "$out" | tail -30
     fi
 done
+# Telemetry smoke: run a tiny trace through the CLI with --telemetry-dir
+# and validate that the RunReport + Chrome-trace artifacts parse (exports
+# must not silently rot; ISSUE 2 CI satellite).
+tel_dir=$(mktemp -d)
+tel_out=$(timeout 1800 python - "$tel_dir" <<'PYEOF' 2>&1
+import json, os, sys, tempfile
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+tel_dir = sys.argv[1]
+from graphite_tpu.events import synth
+trace_path = os.path.join(tel_dir, "smoke.npz")
+synth.gen_radix(2, keys_per_tile=16, radix=8).save(trace_path)
+from graphite_tpu.cli import main
+# interval 500 ns < the 1000 ns quantum, so every quantum samples and
+# even this tiny trace yields round-metric rows
+rc = main(["--telemetry/interval=500", "run", "--trace", trace_path,
+           "--telemetry-dir", tel_dir,
+           "-o", os.path.join(tel_dir, "sim.out")])
+assert rc == 0, f"cli rc={rc}"
+report = json.load(open(os.path.join(tel_dir, "run_report.json")))
+assert report["schema"].startswith("graphite_tpu/run_report")
+assert report["counters"]["icount"] > 0 and report["telemetry"]["time_ps"]
+ct = json.load(open(os.path.join(tel_dir, "run_trace.json")))
+events = ct["traceEvents"]
+assert any(e["ph"] == "X" and "ts" in e and "pid" in e and "tid" in e
+           for e in events), "no X slices in trace export"
+print("TELEMETRY SMOKE OK")
+PYEOF
+)
+tel_rc=$?
+echo "$tel_out" | tail -3
+rm -rf "$tel_dir"
+if [ $tel_rc -ne 0 ]; then
+    fail=1
+fi
+
 if [ $fail -eq 0 ]; then
     echo "ALL MODULES PASSED"
 else
