@@ -25,7 +25,10 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 20  # v20: [telemetry] round-metric sample arrays
+_SCHEMA_VERSION = 21  # v21: quantum-scoped block-window cache arrays
+#   (win_meta/win_addr/win_base/win_seat; zero-width when
+#   tpu/window_cache is off or the window phase is disabled);
+#   v20: [telemetry] round-metric sample arrays
 #   (tel_gauges/tel_cursor/tel_pend; zero-size when telemetry is off);
 #   v19: VMManager accounting scalars (vm_*);
 #   v18: iocoom register scoreboard (reg_ready);

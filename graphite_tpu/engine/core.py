@@ -90,6 +90,54 @@ def _row_word(row: jnp.ndarray, way: jnp.ndarray) -> jnp.ndarray:
 
 # ===================================================== block retirement
 
+def _window_slice_gather(st: SimState, trace: TraceArrays, width: int):
+    """Gather ``width`` events per tile starting at the cursor (seated
+    stream's row under the ThreadScheduler).  Indices clamp at the trace
+    end exactly like the original per-round gather, so cached values are
+    bit-identical to a direct gather at any cursor."""
+    N = trace.num_events
+    pos = st.cursor[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    idx = jnp.minimum(pos, N - 1)
+    if st.sched_enabled:
+        srow = st.seat_stream
+        meta = trace.meta[:, srow[:, None], idx]          # [3, T, width]
+        addr = trace.addr[srow[:, None], idx]             # [T, width]
+    else:
+        meta = jnp.take_along_axis(trace.meta, idx[None], axis=2)
+        addr = jnp.take_along_axis(trace.addr, idx, axis=1)
+    return meta, addr
+
+
+def _window_refresh(params: SimParams, st: SimState, trace: TraceArrays,
+                    tile_active: jnp.ndarray) -> SimState:
+    """Quantum-scoped window cache (tpu/window_cache): re-gather the
+    [T, WC] resident slice only when some ACTIVE tile's next-K events
+    fall outside its cached span (cursor advanced past win_base + WC - K,
+    restored state, or a seat rotation).  The guard is a scalar
+    ``lax.cond`` whose operands are just the window arrays — cache-hit
+    rounds pay an elementwise validity check instead of a full-trace
+    gather."""
+    K = params.block_events
+    WC = st.win_meta.shape[2]
+    d = st.cursor - st.win_base
+    ok = (d >= 0) & (d + K <= WC)
+    if st.sched_enabled:
+        ok = ok & (st.win_seat == st.seat_stream)
+    need = jnp.any(tile_active & ~ok)
+
+    def refresh(_):
+        meta, addr = _window_slice_gather(st, trace, WC)
+        seat = st.seat_stream if st.sched_enabled \
+            else jnp.full_like(st.win_seat, -1)
+        return meta, addr, st.cursor, seat
+
+    def keep(_):
+        return st.win_meta, st.win_addr, st.win_base, st.win_seat
+
+    wm, wa, wb, ws = jax.lax.cond(need, refresh, keep, None)
+    return st._replace(win_meta=wm, win_addr=wa, win_base=wb, win_seat=ws)
+
+
 def _block_retire(params: SimParams, st: SimState,
                   trace: TraceArrays) -> SimState:
     """Retire the leading run of simple events in each tile's [K] window.
@@ -125,18 +173,25 @@ def _block_retire(params: SimParams, st: SimState,
     tile_active = (~st.done) & (st.pend_kind == PEND_NONE) \
         & (in_chain | (st.clock < st.boundary)) & (st.cursor < N)
 
-    # ---- window gather: next K events per tile (two gathers).  With the
+    # ---- window gather: next K events per tile.  With the
     # ThreadScheduler, each tile reads its SEATED stream's trace row.
+    # With the window cache, rounds read the small resident [T, WC] slice
+    # at per-tile offsets (refreshed from the trace only when an active
+    # tile outruns it) — values are bit-identical to the direct gather.
     pos = st.cursor[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
     valid_ev = (pos < N) & tile_active[:, None]
-    idx = jnp.minimum(pos, N - 1)
-    if st.sched_enabled:
-        srow = st.seat_stream
-        meta = trace.meta[:, srow[:, None], idx]                # [3, T, K]
-        addr = trace.addr[srow[:, None], idx]                   # [T, K]
+    if st.win_meta.shape[2] > 0:
+        st = _window_refresh(params, st, trace, tile_active)
+        WC = st.win_meta.shape[2]
+        # Post-refresh every ACTIVE tile's offset is in bounds; inactive
+        # tiles clamp and read junk that valid_ev masks (exactly the junk
+        # the trace-end clamp produced before).
+        off = jnp.clip(st.cursor - st.win_base, 0, WC - K)
+        oidx = off[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
+        meta = jnp.take_along_axis(st.win_meta, oidx[None], axis=2)
+        addr = jnp.take_along_axis(st.win_addr, oidx, axis=1)
     else:
-        meta = jnp.take_along_axis(trace.meta, idx[None], axis=2)
-        addr = jnp.take_along_axis(trace.addr, idx, axis=1)
+        meta, addr = _window_slice_gather(st, trace, K)
     op, arg, arg2 = meta[0], meta[1], meta[2]
     op = jnp.where(valid_ev, op, EventOp.NOP)
 
@@ -1255,38 +1310,45 @@ def local_advance(params: SimParams, state: SimState,
     round is a block retirement (a [T, K] window of simple events +
     banked misses) plus one general slot; the loop exits as soon as a
     round retires nothing anywhere (every tile parked / done / at its
-    boundary / waiting on its miss chain)."""
+    boundary / waiting on its miss chain).
+
+    Progress sums are hoisted into the loop carries (one cursor-sum
+    reduction per round, computed in the body; conds compare scalars) —
+    the old cond/body pairs each re-swept the [T] cursor array, doubling
+    the reduction count on the engine's innermost loops."""
 
     def progress(st):
         return jnp.sum(st.cursor.astype(jnp.int64))
 
     def cond(carry):
-        i, prev, st = carry
+        i, prev, cur, _st = carry
         return (i < params.max_events_per_quantum) \
-            & ((i == 0) | (progress(st) > prev))
+            & ((i == 0) | (cur > prev))
 
     def body(carry):
-        i, _prev, st = carry
-        p0 = progress(st)
+        i, _prev, cur, st = carry
         if params.block_events > 0:
             # Inner window-only loop: the general slot costs as much as a
             # whole window but usually has nothing to do — run windows
             # until they stop retiring, THEN one general slot, repeat.
+            # The carried ``cur`` is the cursor sum at body entry, so it
+            # seeds the inner carry for free.
             def wcond(c):
-                j, pv, s = c
+                j, pv, cv, _s = c
                 return (j < params.max_events_per_quantum) \
-                    & ((j == 0) | (progress(s) > pv))
+                    & ((j == 0) | (cv > pv))
 
             def wbody(c):
-                j, pv, s = c
-                q0 = progress(s)
-                return j + 1, q0, _block_retire(params, s, trace)
+                j, _pv, cv, s = c
+                s = _block_retire(params, s, trace)
+                return j + 1, cv, progress(s), s
 
-            _, _, st = jax.lax.while_loop(
-                wcond, wbody, (jnp.int32(0), jnp.int64(-1), st))
+            _, _, _, st = jax.lax.while_loop(
+                wcond, wbody, (jnp.int32(0), jnp.int64(-1), cur, st))
         st = _complex_slot(params, st, trace)
-        return i + 1, p0, st
+        return i + 1, cur, progress(st), st
 
-    _, _, state = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), jnp.int64(-1), state))
+    _, _, _, state = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.int64(-1), progress(state), state))
     return state

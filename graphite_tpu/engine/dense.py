@@ -54,3 +54,30 @@ def binmax(oh: jnp.ndarray, mask: jnp.ndarray, val: jnp.ndarray,
            init) -> jnp.ndarray:
     """Dense scatter-max: per-bin max of val[r] over rows with mask."""
     return jnp.max(jnp.where(oh & mask[:, None], val[:, None], init), axis=0)
+
+
+# Above DENSE_MAX_ELEMS callers fall back to real scatters, which XLA:TPU
+# dispatches as SEQUENTIAL ops (~150 us each at 1024 tiles — PROFILE.md
+# lever 3).  Scatter cost is per OPERATION, not per payload element, so
+# several per-field scatters that share one index vector stack into a
+# single multi-field scatter: a [F, size] table updated at [:, idx] with a
+# [F, R] payload costs ONE dispatch instead of F.
+
+def stacked_max_table(idx: jnp.ndarray, vals: jnp.ndarray, size: int,
+                      init) -> jnp.ndarray:
+    """[F, size] per-bin max of vals[f, r] over the SHARED idx[r] — one
+    scatter for all F fields.  Mask rows by passing ``init`` (the max
+    identity) as their value instead of masking the index: the op count
+    stays one and masked rows are no-ops."""
+    F = vals.shape[0]
+    return jnp.full((F, size), init, vals.dtype).at[:, idx].max(vals)
+
+
+def stacked_set_table(idx: jnp.ndarray, mask: jnp.ndarray,
+                      vals: jnp.ndarray, tbl: jnp.ndarray) -> jnp.ndarray:
+    """Update tbl[f, idx[r]] = vals[f, r] where mask[r], one scatter for
+    all F rows of ``tbl`` ([F, size]).  Callers guarantee at most one
+    masked row per index value (e.g. per-slot election winners), so the
+    duplicate-index write order XLA leaves unspecified never matters."""
+    size = tbl.shape[1]
+    return tbl.at[:, jnp.where(mask, idx, size)].set(vals, mode="drop")

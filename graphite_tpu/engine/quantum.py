@@ -285,7 +285,14 @@ def quantum_step(params: SimParams, state: SimState,
     Sub-rounds of (local_advance ; resolve) repeat while they make
     progress (any event retired or unblocked — the cursor sum moves),
     capped at ``rounds_per_quantum``; quanta whose work drains in one
-    sub-round (most of them) pay for one instead of the full cap."""
+    sub-round (most of them) pay for one instead of the full cap.
+
+    The progress reductions are HOISTED out of the loop predicate: each
+    round computes its post-round sum once in the body and carries
+    (prev, cur) as scalars, so the cond is pure scalar compares.  The
+    old shape recomputed both full-[T] sums in cond AND body — four
+    reduction sweeps per round where one suffices (PROFILE.md: the
+    round is fixed-op bound at small T)."""
     state = state._replace(boundary=next_boundary(params, state),
                            ctr_quantum=state.ctr_quantum + 1)
     if state.sched_enabled:
@@ -297,19 +304,22 @@ def quantum_step(params: SimParams, state: SimState,
         return jnp.sum(st.cursor.astype(jnp.int64)) + jnp.sum(st.clock)
 
     def cond(carry):
-        i, prev, st = carry
+        i, prev, cur, _st = carry
         return (i < params.rounds_per_quantum) \
-            & ((i == 0) | (progress(st) > prev))
+            & ((i == 0) | (cur > prev))
 
     def body(carry):
-        i, _prev, st = carry
-        p0 = progress(st)
+        i, _prev, cur, st = carry
         st = local_advance(params, st, trace)
         st = resolve(params, st)
-        return i + 1, p0, st
+        # cur (this round's entry progress) becomes the next compare
+        # floor; one reduction pass per round, in the body where it
+        # fuses with the round's epilogue.
+        return i + 1, cur, progress(st), st
 
-    _, _, state = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), jnp.int64(-1), state))
+    _, _, _, state = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.int64(-1), progress(state), state))
     if sampling_enabled(params):
         state = _maybe_sample(params, state)
     return state
@@ -347,11 +357,18 @@ def megarun(params: SimParams, state: SimState, trace: TraceArrays,
     start = state.ctr_quantum
     budget = jnp.asarray(max_quanta, jnp.int64)
 
-    def cond(st: SimState):
-        return (~st.all_done()) \
-            & ((st.ctr_quantum - start) < budget)
+    # The all_done reduction is carried: computed once per quantum at the
+    # END of the body (where it fuses with the quantum's epilogue ops)
+    # instead of re-sweeping the done/strm_done arrays in the cond — the
+    # cond then reads two scalars.
+    def cond(carry):
+        st, done = carry
+        return (~done) & ((st.ctr_quantum - start) < budget)
 
-    def body(st: SimState) -> SimState:
-        return quantum_step(params, st, trace)
+    def body(carry):
+        st, _done = carry
+        st = quantum_step(params, st, trace)
+        return st, st.all_done()
 
-    return jax.lax.while_loop(cond, body, state)
+    state, _ = jax.lax.while_loop(cond, body, (state, state.all_done()))
+    return state

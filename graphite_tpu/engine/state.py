@@ -235,6 +235,28 @@ class SimState(NamedTuple):
     #   the resolved remote latency (e.g. a blocked COMPUTE block's own
     #   cost + fetch time, an atomic's RMW cycle)
 
+    # -- cached block-window trace slice (tpu/window_cache; engine/core.py
+    # _block_retire).  The window phase used to re-gather its [T, K] event
+    # slice from the full device trace EVERY round; miss-dominated traces
+    # retire ~1.4 events/tile/round, so ~90% of that HBM traffic re-read
+    # bytes fetched the round before (PROFILE.md lever 2).  Instead a
+    # [T, WC] slice (WC = 2K) is gathered once and advances with the
+    # cursor: rounds read from this small resident cache, and a full
+    # re-gather happens only when some ACTIVE tile's next-K events fall
+    # outside its cached span (or its seat rotated) — a guarded lax.cond,
+    # so cache-hit rounds never touch the trace.  Values are identical to
+    # a direct gather by construction (same clamped indices), so timing,
+    # counters, and round counts are bit-identical (tests/
+    # test_block_equivalence.py round-identity case).  Zero-width when
+    # the cache or the window phase is disabled.
+    win_meta: jnp.ndarray     # [3, T, WC] int32 (op, arg, arg2)
+    win_addr: jnp.ndarray     # [T, WC] int64
+    win_base: jnp.ndarray     # [T] int32 cursor at gather time (large
+    #   negative = invalid, forces the first refresh)
+    win_seat: jnp.ndarray     # [T] int32 seat_stream at gather time
+    #   (seat rotation invalidates a tile's cached rows; -1 when the
+    #   scheduler is off)
+
     # -- branch predictor (reference: one_bit_branch_predictor.cc)
     bp_table: jnp.ndarray     # [T, bp_size] bool — last outcome per slot
 
@@ -513,6 +535,17 @@ def _num_tel_rows() -> int:
 
 
 NUM_CONDS = 64      # cond-var id space (like max_mutexes; ids clip)
+WIN_BASE_INVALID = -(1 << 30)   # win_base sentinel: forces a refresh
+
+
+def _win_cache_width(params: SimParams) -> int:
+    """Cached block-window width: 2x the [T, K] window, so a tile
+    retiring its full window still serves the NEXT round from cache
+    before a refresh is due.  0 disables (no cache arrays, per-round
+    trace gathers — the pre-cache engine shape)."""
+    if params.window_cache and params.block_events > 0:
+        return 2 * params.block_events
+    return 0
 DRAM_RING_SLOTS = 8  # busy-interval history per memory controller
 MISS_FILTER_SLOTS = 1 << 14   # per-tile miss-type filter entries (2x the
 #                               T1 L2's 8192 lines: "seen" memory must
@@ -574,6 +607,13 @@ def make_state(params: SimParams,
         pend_issue=jnp.zeros(T, dtype=jnp.int64),
         pend_aux=jnp.zeros(T, dtype=jnp.int32),
         pend_extra=jnp.zeros(T, dtype=jnp.int64),
+        win_meta=jnp.zeros((3, T, _win_cache_width(params)),
+                           dtype=jnp.int32),
+        win_addr=jnp.zeros((T, _win_cache_width(params)), dtype=jnp.int64),
+        # Invalid base: the first window round's validity check fails for
+        # every active tile, forcing the initial gather.
+        win_base=jnp.full(T, WIN_BASE_INVALID, dtype=jnp.int32),
+        win_seat=jnp.full(T, -1, dtype=jnp.int32),
         bp_table=jnp.zeros((T, params.core.bp_size), dtype=bool),
         l1i=cachemod.make_cache(T, params.l1i),
         l1d=cachemod.make_cache(T, params.l1d),

@@ -46,6 +46,7 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
 # == num_tiles).
 _TILE_AXIS_BY_FIELD = {
     "word": 1, "meta": 1,            # CacheArrays [A, T, sets] / trace
+    "win_meta": 1,                   # [3, T, WC] window-cache slice
     "dir_word": 1,                   # [A, T*dsets] (tile-major flat)
     "dir_sharers": 1,                # [W*A, T*dsets]
     "ch_time": 1,                    # [D, T, T]

@@ -660,6 +660,13 @@ class SimParams:
     # tile per local round; 0 disables it — every event then goes through
     # the general one-event slot, the round-2 engine shape).
     block_events: int
+    # Quantum-scoped block-window cache: gather the window's trace slice
+    # into resident [T, 2K] SimState arrays that advance with the cursor,
+    # instead of re-gathering [T, K] from the full device trace every
+    # round (engine/core._block_retire; PROFILE.md lever 2).  Results are
+    # bit-identical either way — false restores the per-round gather (the
+    # round-identity oracle in tests/test_block_equivalence.py).
+    window_cache: bool
     max_events_per_quantum: int
     directory_conflict_rounds: int
     rounds_per_quantum: int
@@ -933,6 +940,7 @@ class SimParams:
             telemetry_enabled=cfg.get_bool("telemetry/enabled", False),
             max_stat_samples=cfg.get_int("tpu/max_stat_samples", 1024),
             block_events=_block_events(cfg.get_int("tpu/block_events", 16)),
+            window_cache=cfg.get_bool("tpu/window_cache", True),
             max_events_per_quantum=cfg.get_int("tpu/max_events_per_quantum"),
             directory_conflict_rounds=cfg.get_int("tpu/directory_conflict_rounds"),
             rounds_per_quantum=cfg.get_int("tpu/rounds_per_quantum", 4),

@@ -75,3 +75,49 @@ def test_round_robin_equivalent():
     over = {"l1_dcache/replacement_policy": "round_robin",
             "l1_icache/replacement_policy": "round_robin"}
     _assert_equal(_run(trace, 4, 0, **over), _run(trace, 4, 16, **over))
+
+
+ROUND_CTRS = ("ctr_quantum", "ctr_window", "ctr_complex", "ctr_conflict",
+              "ctr_resolve", "round_ctr")
+
+
+def _run_sim(trace, num_tiles, **over):
+    cfg = load_config()
+    cfg.set("general/total_cores", num_tiles)
+    for k, v in over.items():
+        cfg.set(k, v)
+    params = SimParams.from_config(cfg)
+    sim = Simulator(params, trace)
+    summary = sim.run(max_steps=256)
+    return sim, summary
+
+
+@pytest.mark.parametrize("num_tiles", [
+    8,
+    pytest.param(64, marks=pytest.mark.slow),   # T=64 pays 2 big compiles
+])
+def test_round_identity_window_cache(num_tiles):
+    """Round-identity oracle for the throughput overhaul (ISSUE 3): the
+    quantum-scoped window cache (plus the hoisted progress reductions it
+    runs under) must leave the engine's ROUND STRUCTURE untouched — not
+    just final timing.  With the cache off, _block_retire re-gathers its
+    [T, K] slice from the trace every round (the seed engine's shape);
+    with it on, rounds read the resident [T, 2K] slice.  Both runs must
+    retire the same events in the same rounds: every phase-execution
+    counter (quanta, window retirements, complex slots, resolve passes,
+    conflict rounds) and the final per-tile clocks are bit-identical."""
+    trace = synth.gen_radix(num_tiles=num_tiles,
+                            keys_per_tile=16 if num_tiles >= 64 else 48,
+                            radix=16, seed=5)
+    sim_on, a = _run_sim(trace, num_tiles,
+                         **{"tpu/window_cache": "true"})
+    sim_off, b = _run_sim(trace, num_tiles,
+                          **{"tpu/window_cache": "false"})
+    assert a.done.all() and b.done.all()
+    for f in ROUND_CTRS:
+        va = int(getattr(sim_on.state, f))
+        vb = int(getattr(sim_off.state, f))
+        assert va == vb, f"{f}: cached {va} != uncached {vb}"
+    np.testing.assert_array_equal(a.clock, b.clock)
+    for k in a.counters:
+        np.testing.assert_array_equal(a.counters[k], b.counters[k], k)

@@ -12,6 +12,7 @@ Each scheme's characteristic signature vs full_map, on the same trace:
   ackwise — broadcast traffic (T-1 packets) at full_map latency.
 """
 
+import pytest
 import numpy as np
 
 from graphite_tpu.config import load_config
@@ -107,6 +108,7 @@ def test_ackwise_broadcast_traffic_fullmap_latency():
     assert s_a.completion_time_ps == s_f.completion_time_ps
 
 
+@pytest.mark.slow   # compile-heavy: tier-1 runs -m 'not slow'
 def test_under_cap_entries_behave_like_fullmap():
     """Entries below the pointer cap must be bit-identical to full_map in
     both time and traffic, for every scheme."""

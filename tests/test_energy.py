@@ -96,6 +96,7 @@ def test_dvfs_voltage_scales_dynamic_energy():
     assert float(e2.core.sum()) < float(e1.core.sum())
 
 
+@pytest.mark.slow   # compile-heavy: tier-1 runs -m 'not slow'
 def test_energy_across_protocols():
     for proto in ("pr_l1_pr_l2_dram_directory_mosi", "pr_l1_sh_l2_mesi"):
         _, s, e = _run_energy(**{"caching_protocol/type": proto})

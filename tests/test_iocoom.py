@@ -9,6 +9,7 @@ off) wait for every outstanding completion; the simple model stalls the
 full round trip at the miss itself.
 """
 
+import pytest
 import numpy as np
 
 from graphite_tpu.config import load_config
@@ -107,6 +108,7 @@ def test_iocoom_lq_backpressure():
     assert t_wide < t_one
 
 
+@pytest.mark.slow   # compile-heavy: tier-1 runs -m 'not slow'
 def test_iocoom_radix_runs_and_beats_simple_time():
     # End-to-end sanity on a real trace family: same work, earlier finish.
     trace = synth.gen_radix(8, keys_per_tile=128, radix=64)
@@ -205,6 +207,7 @@ def _two_tile_miss_compute_trace(n_loads=4, cost=200):
     return tb.build()
 
 
+@pytest.mark.slow   # compile-heavy: tier-1 runs -m 'not slow'
 def test_heterogeneous_tiles_run_their_own_model():
     """A mixed <simple, iocoom> run gives each tile EXACTLY its
     homogeneous model's timing (tiles decoupled: private lines, no DRAM

@@ -65,10 +65,13 @@ def test_capacity_misses():
     """A working set larger than L2 re-misses as capacity on the second
     pass (lines were seen, then evicted by replacement).  The seen
     filter is direct-mapped, so collisions turn SOME second-pass misses
-    back into cold — assert the qualitative split, not exact counts."""
-    params = make_params(2)
-    # L2 = 512 KB -> 8192 lines; stream 1.5x that
-    nlines = 12288
+    back into cold — assert the qualitative split, not exact counts.
+
+    The L2 is shrunk to 32 KB so 1.5x its line count is 768 lines, not
+    the default geometry's 12288 — each line is a serialized miss round,
+    and the full-size variant alone ate ~70 s of the tier-1 budget."""
+    params = make_params(2, **{"l2_cache/T1/cache_size": 32})
+    nlines = (params.l2.num_sets * params.l2.associativity * 3) // 2
     tb = TraceBuilder(2)
     for p in range(2):
         for i in range(nlines):

@@ -9,6 +9,7 @@ These tests pin that contract against the MSI baseline, plus the directory
 invariants under O entries.
 """
 
+import pytest
 import numpy as np
 
 from graphite_tpu.config import load_config
@@ -47,6 +48,7 @@ def _producer_reader_trace(readers=2):
     return tb.build()
 
 
+@pytest.mark.slow   # compile-heavy: tier-1 runs -m 'not slow'
 def test_owner_forwards_without_dram():
     """SH on M: MOSI forwards from the owner — no DRAM write, no DRAM
     read for this or any later reader; MSI writes back and re-reads."""

@@ -6,6 +6,7 @@ packets serialize in FCFS order against carried link horizons; an idle
 mesh reproduces the zero-load hop-counter latency exactly.
 """
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -91,6 +92,7 @@ def test_distinct_links_no_interference():
     assert int(np.asarray(r.wait_ps).sum()) == 0
 
 
+@pytest.mark.slow   # compile-heavy: tier-1 runs -m 'not slow'
 def test_e2e_contended_slower_than_zero_load():
     """BASELINE config-5 shape: all tiles hammer lines homed at one tile;
     the contended model must charge visibly more time than hop-counter."""

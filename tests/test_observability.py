@@ -5,6 +5,7 @@ plus the simulator's own run telemetry (graphite_tpu/obs: host span
 tracing, device round metrics, RunReport / Chrome-trace export).
 """
 
+import pytest
 import functools
 import json
 
@@ -311,6 +312,7 @@ def test_chrome_trace_device_tracks():
     assert any(e["name"] == "events_retired" for e in counters)
 
 
+@pytest.mark.slow   # compile-heavy: tier-1 runs -m 'not slow'
 def test_telemetry_disabled_is_bit_identical_and_unallocated():
     trace = synth.gen_radix(4, keys_per_tile=128, radix=16)
     s_off = run_simulation(make_params(4), trace)

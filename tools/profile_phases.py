@@ -2,7 +2,14 @@
 
 Times local_advance, resolve, and the fused megastep separately on the
 attached backend at several tile counts, printing one JSON line per
-config.  Usage: python tools/profile_phases.py [tiles ...]
+config.
+
+Usage: python tools/profile_phases.py [tiles ...] [--set sec/key=val ...]
+
+``--set`` forwards config overrides (same syntax as profile_round.py),
+making before/after phase tables for engine knobs reproducible, e.g.
+``--set tpu/window_cache=false`` for the pre-cache gather-per-round
+engine.
 """
 
 import json
@@ -31,10 +38,23 @@ def bench_fn(fn, *args, iters=8):
 
 
 def main():
-    tiles = [int(a) for a in sys.argv[1:]] or [64, 256, 1024]
+    overrides = []
+    plain = []
+    it = iter(sys.argv[1:])
+    for a in it:
+        if a == "--set":
+            overrides.append(next(it))
+        elif a.startswith("--set="):
+            overrides.append(a[len("--set="):])
+        else:
+            plain.append(a)
+    tiles = [int(a) for a in plain] or [64, 256, 1024]
     for T in tiles:
         cfg = load_config()
         cfg.set("general/total_cores", T)
+        for ov in overrides:
+            key, _, val = ov.partition("=")
+            cfg.set(key, val)
         params = SimParams.from_config(cfg)
         trace = synth.gen_radix(num_tiles=T, keys_per_tile=2048, seed=1)
         ta = TraceArrays.from_trace(trace)
@@ -52,13 +72,16 @@ def main():
 
         # events retired in the first local_advance
         ev = int(jax.device_get(state2.cursor.sum()))
-        print(json.dumps({
+        row = {
             "tiles": T,
             "local_advance_s": round(t_la, 5),
             "resolve_s": round(t_rs, 5),
             "megastep_s": round(t_ms, 5),
             "events_first_la": ev,
-        }), flush=True)
+        }
+        if overrides:
+            row["overrides"] = overrides
+        print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,9 +1,16 @@
 """Fused per-round device-cost breakdown on the attached backend.
 
-Times block retirement, the complex slot, and resolve separately (each
-iterated inside one jitted fori_loop on a mid-run state) — the numbers
-that matter for the engine's rounds/sec ceiling.
-Usage: python tools/profile_round.py [tiles] [iters]
+Times block retirement, the complex slot, resolve, and the whole quantum
+step separately (each iterated inside one jitted fori_loop on a mid-run
+state) — the numbers that matter for the engine's rounds/sec ceiling.
+
+Usage: python tools/profile_round.py [tiles] [iters] [--set sec/key=val ...]
+
+``--set`` forwards config overrides, so before/after comparisons of the
+engine's perf knobs are one command each, e.g.:
+
+    python tools/profile_round.py 1024 20 --set tpu/window_cache=false
+    python tools/profile_round.py 1024 20 --set tpu/block_events=4
 """
 
 import sys
@@ -46,22 +53,44 @@ def fused(fn, state, ta, iters):
 
 
 def main():
-    T = int(sys.argv[1]) if len(sys.argv) > 1 else 64
-    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    overrides = []
+    args = []
+    it = iter(sys.argv[1:])
+    for a in it:
+        if a == "--set":
+            overrides.append(next(it))
+        elif a.startswith("--set="):
+            overrides.append(a[len("--set="):])
+        else:
+            args.append(a)
+    T = int(args[0]) if len(args) > 0 else 64
+    iters = int(args[1]) if len(args) > 1 else 50
     cfg = load_config()
     cfg.set("general/total_cores", T)
+    for ov in overrides:
+        key, _, val = ov.partition("=")
+        cfg.set(key, val)
     params = SimParams.from_config(cfg)
     trace = synth.gen_radix(num_tiles=T, keys_per_tile=256, seed=1)
     sim = Simulator(params, trace)
     sim.run(max_steps=4)   # mid-run state: warm caches, parked requests
     state, ta = sim.state, sim.trace
+    if overrides:
+        print(f"overrides: {' '.join(overrides)}", flush=True)
 
-    for name, fn in [
-        ("block", lambda s, t: _block_retire(params, s, t)),
+    from graphite_tpu.engine import quantum
+    phases = [
         ("complex", lambda s, t: _complex_slot(params, s, t)),
         ("resolve_memory", lambda s, t: rs.resolve_memory(params, s)),
         ("resolve_all", lambda s, t: rs.resolve(params, s)),
-    ]:
+        # The full quantum step (local rounds + resolve + boundary +
+        # sampling): iterated cost ~= the engine's whole-round floor.
+        ("quantum_step", lambda s, t: quantum.quantum_step(params, s, t)),
+    ]
+    if params.block_events > 0:
+        phases.insert(0, ("block",
+                          lambda s, t: _block_retire(params, s, t)))
+    for name, fn in phases:
         us = fused(fn, state, ta, iters)
         print(f"T={T} {name}: {us:.0f} us/round", flush=True)
 

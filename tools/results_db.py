@@ -14,7 +14,13 @@ Usage:
     python tools/results_db.py list results.db [workload]
     python tools/results_db.py best results.db workload metric
 
-Importable: ``open_db``, ``add_run``, ``query``.
+``add`` also flags engine-throughput regressions: each ingested row's
+rounds/s (bench ``engine_rounds`` or RunReport ``quanta`` over
+``host_seconds``) is compared against the most recent prior run of the
+same workload, and a drop of more than 20% prints a ``REGRESSION`` line
+(exit code stays 0 — the flag is for CI greps and humans, not a gate).
+
+Importable: ``open_db``, ``add_run``, ``query``, ``check_regression``.
 """
 
 from __future__ import annotations
@@ -41,10 +47,51 @@ CREATE INDEX IF NOT EXISTS runs_workload ON runs (workload, ts);
 """
 
 
+REGRESSION_PCT = 20.0
+
+
 def open_db(path: str) -> sqlite3.Connection:
     db = sqlite3.connect(path)
     db.executescript(_SCHEMA)
     return db
+
+
+def rounds_per_sec(row: dict):
+    """Engine throughput of an ingested row: engine rounds (bench rows)
+    or quanta (RunReports) over host seconds; None when not derivable."""
+    rounds = row.get("engine_rounds") or row.get("quanta")
+    host_s = row.get("host_seconds")
+    if not rounds or not host_s:
+        return None
+    return float(rounds) / float(host_s)
+
+
+def check_regression(db: sqlite3.Connection, workload: str, row: dict,
+                     threshold_pct: float = REGRESSION_PCT):
+    """Compare ``row``'s rounds/s against the most recent COMPARABLE
+    prior run of the same workload already in the DB (skipped_budget/
+    failed rows carry no throughput and are stepped over, so one bad
+    ingest can't mask later regressions); returns a warning string when
+    it regressed by more than ``threshold_pct``, else None.  Call BEFORE
+    add_run so the comparison point is genuinely prior."""
+    new = rounds_per_sec(row)
+    if new is None:
+        return None
+    old = None
+    for (raw,) in db.execute(
+            "SELECT raw_json FROM runs WHERE workload = ? "
+            "ORDER BY ts DESC, id DESC", (workload,)):
+        old = rounds_per_sec(json.loads(raw))
+        if old is not None:
+            break
+    if old is None or old <= 0:
+        return None
+    drop = (old - new) / old * 100.0
+    if drop > threshold_pct:
+        return (f"REGRESSION {workload}: {new:.1f} rounds/s vs prior "
+                f"{old:.1f} (-{drop:.0f}% > {threshold_pct:.0f}% "
+                f"threshold)")
+    return None
 
 
 def add_run(db: sqlite3.Connection, workload: str, row: dict,
@@ -80,17 +127,29 @@ def main(argv) -> int:
     if cmd == "add":
         src = argv[3] if len(argv) > 3 else "-"
         text = sys.stdin.read() if src == "-" else open(src).read()
-        data = json.loads(text)
+        try:
+            data = json.loads(text)
+        except json.JSONDecodeError:
+            # bench.py's un-killable protocol re-emits the whole object
+            # as one line per row; the LAST complete line is the record.
+            data = json.loads(
+                [l for l in text.splitlines() if l.strip()][-1])
         # Accept a bench.py top-level object (detail rows), a RunReport
         # (graphite_tpu/obs export — carries its own workload key), or a
         # single bare row.
+        def _add(name, row):
+            warn = check_regression(db, name, row)
+            add_run(db, name, row)
+            if warn:
+                print(warn)
+
         if "detail" in data:
             for name, row in data["detail"].items():
                 if isinstance(row, dict):
-                    add_run(db, name, row)
+                    _add(name, row)
             print(f"added {len(data['detail'])} rows")
         else:
-            add_run(db, data.get("workload") or "run", data)
+            _add(data.get("workload") or "run", data)
             print("added 1 row")
     elif cmd == "list":
         for r in query(db, argv[3] if len(argv) > 3 else None):

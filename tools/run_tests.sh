@@ -56,6 +56,69 @@ if [ $tel_rc -ne 0 ]; then
     fail=1
 fi
 
+# Budgeted perf smoke (ISSUE 3 CI satellite): a tiny trace must clear a
+# conservative rounds/s floor (catches accidental 10x round-cost
+# regressions, not noise), and bench.py's --help and budget/emit
+# protocol must work without device work (stubbed rows), so a driver
+# bench can never again die numberless to a timeout.
+perf_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import io, json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.getcwd())
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+cfg = load_config()
+cfg.set("general/total_cores", 2)
+params = SimParams.from_config(cfg)
+trace = synth.gen_radix(2, keys_per_tile=32, radix=8)
+Simulator(params, trace).run()            # warm the compile cache
+sim = Simulator(params, trace)
+t0 = time.perf_counter()
+sim.run()
+dt = time.perf_counter() - t0
+import jax
+rounds = int(jax.device_get(sim.state.round_ctr))
+rps = rounds / max(dt, 1e-9)
+assert rps > 5.0, f"rounds/s floor: {rps:.1f} <= 5 ({rounds} rounds in {dt:.2f}s)"
+print(f"perf smoke: {rps:.0f} rounds/s ({rounds} rounds, {dt:.2f}s)")
+
+# bench.py budget protocol, no device work: stub the row runners and
+# force budget 0 — every non-headline row must emit skipped_budget and
+# the line must re-print incrementally.
+import bench
+stub = {"kind": "completed", "num_tiles": 64, "mips": 1.0,
+        "total_instructions": 1, "host_seconds": 0.01}
+bench._run = lambda *a, **k: dict(stub)
+bench._captured_row = lambda name: {"kind": "skipped", "reason": "stub"}
+os.environ["GRAPHITE_BENCH_BUDGET_S"] = "0"
+buf = io.StringIO()
+from contextlib import redirect_stdout
+with redirect_stdout(buf):
+    rc = bench.main([])
+assert rc == 0, f"bench.main rc={rc}"
+lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+assert len(lines) >= 5, f"bench must re-emit per row, got {len(lines)} lines"
+out = json.loads(lines[-1])
+skipped = [k for k, v in out["detail"].items()
+           if isinstance(v, dict) and v.get("kind") == "skipped_budget"]
+assert skipped, f"budget=0 must skip rows: {out['detail'].keys()}"
+assert json.loads(lines[0])["metric"] == "simulated_mips_radix64"
+print(f"bench budget smoke: {len(lines)} emits, {len(skipped)} skipped_budget rows")
+PYEOF
+)
+perf_rc=$?
+echo "$perf_out" | tail -3
+if [ $perf_rc -ne 0 ]; then
+    fail=1
+fi
+if ! timeout 60 python bench.py --help > /dev/null 2>&1; then
+    echo "bench.py --help FAILED"
+    fail=1
+fi
+
 if [ $fail -eq 0 ]; then
     echo "ALL MODULES PASSED"
 else
