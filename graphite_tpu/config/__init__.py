@@ -264,3 +264,32 @@ def load_config(
         for p, v in overrides:
             cfg.set(p, v)
     return cfg
+
+
+def split_set_overrides(argv):
+    """Partition ``argv`` into (positional_args, overrides) where the
+    overrides are the ``sec/key=val`` payloads of ``--set sec/key=val``
+    or ``--set=sec/key=val`` flags — the shared flag grammar of the
+    profiling tools (tools/profile_round.py, tools/profile_phases.py),
+    extracted so the two parsers cannot drift."""
+    overrides: List[str] = []
+    plain: List[str] = []
+    it = iter(argv)
+    for a in it:
+        if a == "--set":
+            try:
+                overrides.append(next(it))
+            except StopIteration:
+                raise SystemExit("--set requires a sec/key=val argument")
+        elif a.startswith("--set="):
+            overrides.append(a[len("--set="):])
+        else:
+            plain.append(a)
+    return plain, overrides
+
+
+def apply_set_overrides(cfg: "Config", overrides) -> None:
+    """Apply ``sec/key=val`` override strings onto a Config in order."""
+    for ov in overrides:
+        key, _, val = ov.partition("=")
+        cfg.set(key, val)

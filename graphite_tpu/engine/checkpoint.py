@@ -25,7 +25,10 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 21  # v21: quantum-scoped block-window cache arrays
+_SCHEMA_VERSION = 22  # v22: blocking-semantics miss chains — banked
+#   elements no longer install at bank time, so the mq_victim array is
+#   gone (resolve fills at serve time and derives victims then);
+#   v21: quantum-scoped block-window cache arrays
 #   (win_meta/win_addr/win_base/win_seat; zero-width when
 #   tpu/window_cache is off or the window phase is disabled);
 #   v20: [telemetry] round-metric sample arrays

@@ -143,18 +143,25 @@ def _block_retire(params: SimParams, st: SimState,
     """Retire the leading run of simple events in each tile's [K] window.
 
     With ``tpu/miss_chain`` > 0 the window also executes PAST L2 misses
-    (the round-4 perf design): a missing line is installed optimistically
-    at its requested state, the request is banked into the tile's miss
-    chain (mq_*; engine/state.py) with the local time since the previous
-    chain element recorded as its issue delta, and execution continues on
-    a RELATIVE clock.  One resolve pass later prices the whole chain in
-    FCFS order — so a tile costs ~one device round per chain instead of
-    one per miss.  Events needing an absolute clock (STALL/SYNC floors,
-    SPAWN, iocoom drains) retire only on an empty chain; everything else
-    (compute/branch/hits/local fills/further misses) rides the relative
-    clock.  In-order timing is exact: the core stalls on each miss, so
-    the continuation point of element k is its completion, and later
-    events' times are completion + accumulated local dt.
+    with BLOCKING semantics (the round-7 design; the round-4 optimistic-
+    install variant modeled a non-blocking MSHR machine and was retired —
+    see tests/test_chain_equivalence.py): the request is banked into the
+    tile's miss chain (mq_*; engine/state.py) with the local time since
+    the previous chain element recorded as its issue delta, the line is
+    NOT installed (the resolve pass installs it at serve time, against
+    the then-current directory state), and execution continues on a
+    RELATIVE clock.  Stall-on-use keeps the machine in-order: any later
+    window event that could observe a banked element's future fill — a
+    set-collision in the cache level the fill will land in, which
+    subsumes a touch of the missed line itself — stops the window until
+    the chain drains, exactly where the reference's blocking core would
+    still be stalled.  One resolve pass prices whole chains in FCFS
+    order — ~one device round per chain instead of one per miss.  Events
+    needing an absolute clock (STALL/SYNC floors, SPAWN, iocoom drains)
+    retire only on an empty chain.  In-order timing is exact: the core
+    stalls on each miss, so the continuation point of element k is its
+    completion, and later events' times are completion + accumulated
+    local dt.
     """
     K = params.block_events
     T = params.num_tiles
@@ -252,16 +259,16 @@ def _block_retire(params: SimParams, st: SimState,
 
     # Bankable misses (miss both levels, or a write upgrade of a
     # non-writable resident line) — retire by banking when chain slots
-    # remain.  Atomics stay complex (drain points).
+    # remain.  Atomics stay complex (drain points).  Banking does NOT
+    # install the line (blocking semantics: the resolve pass fills at
+    # serve time); the hazard/forwarding rules below decide what may
+    # retire behind an outstanding bank.
     if P > 0:
-        mem_bank = is_mem & ~l1_ok & ~mem_l2
-        comp_bank = is_comp & ~pI.hit & ~comp_l2
-        fill_bank_d = mem_bank                # L1D install at bank time
-        fill_bank_i = comp_bank               # L1I install
+        mem_bank0 = is_mem & ~l1_ok & ~mem_l2
+        comp_bank0 = is_comp & ~pI.hit & ~comp_l2
     else:
-        mem_bank = jnp.zeros_like(l1_ok)
-        comp_bank = jnp.zeros_like(l1_ok)
-        fill_bank_d = fill_bank_i = mem_bank
+        mem_bank0 = jnp.zeros_like(l1_ok)
+        comp_bank0 = jnp.zeros_like(l1_ok)
 
     # iocoom drain: branches are drain points without speculative loads —
     # the drain floor (max outstanding LQ/SQ completion) is constant over
@@ -283,12 +290,55 @@ def _block_retire(params: SimParams, st: SimState,
     else:
         drain_ev = jnp.zeros_like(is_br)
 
+    ar = jnp.arange(K)
+    earlier = ar[None, :, None] > ar[None, None, :]           # [1, K, K]
+
+    # ---- chain forwarding (hit-on-pending-fill): a re-access of a line
+    # with an outstanding banked element retires as the post-fill HIT
+    # the blocking oracle sees (the fill completes before the core
+    # reaches the use), charged the plain L1 hit cost — for READS only:
+    # a write to a banked line always stalls for the drain and
+    # re-probes, because write ownership is exactly what a concurrent
+    # EX steal takes away (forwarding writes hid those steals and
+    # drifted completion well past the 2% oracle gate).  This
+    # is what lets a chain run past the 8-16 sequential touches every
+    # streamed line gets: without it the second touch of a just-banked
+    # line would end every chain at depth ~1.
+    if P > 0:
+        same_line_w = line[:, :, None] == line[:, None, :]    # [T, Kj, Ki]
+        fwd_win_d = (earlier & same_line_w & mem_bank0[:, None, :]
+                     & is_rd[:, :, None]).any(axis=2)
+        fwd_win_i = (earlier & same_line_w
+                     & comp_bank0[:, None, :]).any(axis=2)
+        # Pending elements banked in earlier rounds ([P, T] chain state).
+        slots_pc = jnp.arange(P, dtype=jnp.int32)[:, None]    # [P, 1]
+        pvalid = (slots_pc >= st.mq_head[None, :]) \
+            & (slots_pc < st.mq_count[None, :])               # [P, T]
+        pline = st.mq_req >> 8
+        pkind = (st.mq_req & 7).astype(jnp.int32)
+        p_is_if = pkind == PEND_IFETCH
+        pend_memT = (pvalid & ~p_is_if).T[:, None, :]         # [T, 1, P]
+        pend_ifT = (pvalid & p_is_if).T[:, None, :]
+        linematch_p = line[:, :, None] == pline.T[:, None, :]  # [T, K, P]
+        cover_pd = linematch_p & pend_memT & is_rd[:, :, None]
+        cover_pi = linematch_p & pend_ifT
+        fwd_pend_d = jnp.any(cover_pd, axis=2)
+        fwd_pend_i = jnp.any(cover_pi, axis=2)
+        mem_fwd = mem_bank0 & (fwd_win_d | fwd_pend_d)
+        comp_fwd = comp_bank0 & (fwd_win_i | fwd_pend_i)
+    else:
+        mem_fwd = comp_fwd = jnp.zeros_like(l1_ok)
+    mem_bank = mem_bank0 & ~mem_fwd
+    comp_bank = comp_bank0 & ~comp_fwd
+    mem_simple = mem_simple | mem_fwd
+    comp_simple = comp_simple | comp_fwd
+    fill_bank_d = mem_bank                    # future L1D fill (hazards)
+    fill_bank_i = comp_bank                   # future L1I fill
+
     # ---- fill hazards: an event is unsafe once an earlier in-window fill
     # (or, for a fill's own victim choice, any earlier same-set access)
     # could have changed what its window-start probe saw.  One fill per
     # tile per level per window keeps the fill apply path [T]-shaped.
-    ar = jnp.arange(K)
-    earlier = ar[None, :, None] > ar[None, None, :]           # [1, K, K]
 
     def _hazard(fills, accesses, set_idx):
         """accesses[j] unsafe if exists i<j with fills[i] & same set."""
@@ -308,48 +358,100 @@ def _block_retire(params: SimParams, st: SimState,
         else jnp.zeros_like(touch_d)
     all_fill_d = fill_d | fill_bank_d
     all_fill_i = fill_i | fill_bank_i
-    haz_d = _hazard(all_fill_d | upg_d, is_mem, pD.set_idx) \
-        | _hazard(touch_d | all_fill_d, all_fill_d, pD.set_idx)
-    haz_i = _hazard(all_fill_i, is_comp, pI.set_idx) \
-        | _hazard(touch_i | all_fill_i, all_fill_i, pI.set_idx)
+    haz_d = _hazard(fill_d | upg_d, is_mem, pD.set_idx) \
+        | _hazard(touch_d | fill_d, fill_d, pD.set_idx)
+    haz_i = _hazard(fill_i, is_comp, pI.set_idx) \
+        | _hazard(touch_i | fill_i, fill_i, pI.set_idx)
+    # Banked (serve-time) fills: a later access in the SAME L1 SET could
+    # be hitting the line the future fill will evict.  Under SHARED L2
+    # that staleness is expensive (the L1 is the only local level — a
+    # missed eviction turns a remote slice round trip into a local hit),
+    # so same-set accesses stall, except a same-line covered re-access
+    # (that line IS the fill, never its victim).  Under a private
+    # (inclusive) L2 the evicted line falls back to the local L2, so the
+    # worst mispricing is one l2_ps — noise the 2% oracle absorbs — and
+    # stalling for it would cap chains at the L1 set count; no hazard.
+    # Banks themselves need no victim-staleness hazard: their victim is
+    # chosen at serve time, after every window effect has landed.
+    if P > 0 and shared_l2:
+        ssD = pD.set_idx[:, :, None] == pD.set_idx[:, None, :]
+        haz_d = haz_d | (is_mem & (
+            earlier & ssD & ~same_line_w
+            & fill_bank_d[:, None, :]).any(axis=2))
+        ssI = pI.set_idx[:, :, None] == pI.set_idx[:, None, :]
+        haz_i = haz_i | (is_comp & (
+            earlier & ssI & ~same_line_w
+            & fill_bank_i[:, None, :]).any(axis=2))
+    if P > 0:
+        # Uncovered same-line use of an IN-WINDOW bank always stalls
+        # (the no-duplicate-lines-per-chain invariant, window half).
+        uncov_w = earlier & same_line_w & (
+            (is_mem[:, :, None] & comp_bank0[:, None, :])
+            | (is_wr[:, :, None] & mem_bank0[:, None, :])
+            | (is_comp[:, :, None] & mem_bank0[:, None, :]))
+        hazard_uncov = uncov_w.any(axis=2)
+        haz_d = haz_d | (is_mem & hazard_uncov)
+        haz_i = haz_i | (is_comp & hazard_uncov)
     hazard = haz_d | haz_i
 
-    # L2 install candidates (private): chosen way + victim from the
-    # window-start rows, used for the L2 set/value hazards, the post-loop
-    # install scatter, and the banked victim record.
+    # Banked-miss L2 hazards (private): the serve-time fill will touch
+    # the banked line's L2 set (choosing a victim then, against the
+    # post-serve state), so any L2-consulting event after a same-L2-set
+    # bank declines — except a covered same-line re-access.  The
+    # set-collision rule subsumes the inclusion hazard (the future L2
+    # victim lives in the same set as the banked line, so an L1 hit on
+    # it is a same-L2-set memory event).
     l2_fill_cand = mem_bank | comp_bank
     if P > 0 and not shared_l2:
-        A2 = st.l2.word.shape[0]
-        st_row2 = cachemod.word_state(pL2.row)            # [A2, T, K]
-        inv2 = st_row2 == I
-        has_inv2 = inv2.any(axis=0)
-        first_inv2 = jnp.argmax(inv2, axis=0)
-        if params.l2.replacement == "round_robin":
-            rr2 = jnp.take_along_axis(st.l2.rr_ptr, pL2.set_idx, axis=1)
-            pol2 = rr2 % A2
+        l2ss = pL2.set_idx[:, :, None] == pL2.set_idx[:, None, :]
+        l2_cover = same_line_w & (
+            (is_mem[:, :, None] & mem_bank0[:, None, :]
+             & is_rd[:, :, None])
+            | (is_comp[:, :, None] & comp_bank0[:, None, :]))
+        hazard = hazard | ((is_mem | is_comp) & (
+            earlier & l2ss & ~l2_cover
+            & l2_fill_cand[:, None, :]).any(axis=2))
+
+    # Pending-chain hazards (stall-on-use across rounds): elements banked
+    # in EARLIER rounds have fills still outstanding; any window event
+    # whose probe could be invalidated by one of those future fills —
+    # same L1D/L1I set as a pending fill of its kind, or (private) same
+    # L2 set as any pending element — must wait for the chain to drain
+    # and re-probe the post-serve state, exactly where the reference's
+    # blocking core would still be stalled on the miss.  Covered exact-
+    # line matches forward instead (above).
+    if P > 0:
+        # Uncovered exact-line re-accesses of a pending element always
+        # stall, at every hierarchy shape: a write under a pending SH
+        # must re-probe for its upgrade miss, and an uncovered bankable
+        # use must NOT bank — no chain may ever hold one line twice
+        # (the fast pass's conflict-free groups rely on it).
+        pvT0 = pvalid.T[:, None, :]
+        haz_pend = (is_mem & jnp.any(
+            linematch_p & pvT0 & ~cover_pd, axis=2)) \
+            | (is_comp & jnp.any(
+                linematch_p & pvT0 & ~cover_pi, axis=2))
+        if shared_l2:
+            # L1-set staleness matters here (see the in-window variant).
+            pd_set = cachemod.set_index(pline, params.l1d.num_sets).T
+            pi_set = cachemod.set_index(pline, params.l1i.num_sets).T
+            haz_pend = haz_pend | (is_mem & jnp.any(
+                pend_memT & ~cover_pd
+                & (pD.set_idx[:, :, None] == pd_set[:, None, :]), axis=2)) \
+                | (is_comp & jnp.any(
+                    pend_ifT & ~cover_pi
+                    & (pI.set_idx[:, :, None] == pi_set[:, None, :]),
+                    axis=2))
         else:
-            pol2 = jnp.argmin(cachemod.word_stamp(pL2.row), axis=0)
-        vic_way2 = jnp.where(has_inv2, first_inv2, pol2)
-        # Resident upgrade (EX to a non-writable resident line) installs
-        # in place — no victim.
-        fway2 = jnp.where(pL2.hit, pL2.way, vic_way2).astype(jnp.int32)
-        vic_word2 = _row_word(pL2.row, fway2)
-        l2_vic_tag = cachemod.word_tag(vic_word2).astype(jnp.int64)
-        l2_vic_state = jnp.where(pL2.hit, I, cachemod.word_state(vic_word2))
-        # L2 hazards: any L2-consulting event after a same-L2-set install
-        # (and any install after a same-set consult — victim staleness).
-        l2_probing = is_mem | is_comp
-        hazard = hazard \
-            | _hazard(l2_fill_cand, l2_probing, pL2.set_idx) \
-            | _hazard(l2_probing | l2_fill_cand, l2_fill_cand, pL2.set_idx)
-        # Inclusion value hazard: an install drops its L2 victim's L1D
-        # copy, so a later event L1-hitting the victim LINE must not
-        # retire against the stale window-start probe.
-        vic_live_c = (l2_vic_state != I) & l2_fill_cand
-        hazard = hazard | (
-            (is_mem & l1_ok)
-            & (earlier & (l2_vic_tag[:, None, :] == line[:, :, None])
-               & vic_live_c[:, None, :]).any(axis=2))
+            # Private L2: the L2-set hazard is the one that matters (a
+            # missed L2 victim eviction hides a full re-request).
+            p2_set = cachemod.set_index(pline, params.l2.num_sets).T
+            pvT = pvalid.T[:, None, :]
+            haz_pend = haz_pend | ((is_mem | is_comp) & jnp.any(
+                pvT & ~(cover_pd | cover_pi)
+                & (pL2.set_idx[:, :, None] == p2_set[:, None, :]),
+                axis=2))
+        hazard = hazard | haz_pend
 
     # Retire classes.  Models disabled: the window retires NOTHING — tiles
     # go one event per general slot, exactly the round-2 lockstep.  ROI
@@ -453,7 +555,13 @@ def _block_retire(params: SimParams, st: SimState,
         if P > 0:
             bank_j = ok_bank[:, j] & (nm < P)
             okj = ok_rel[:, j] | (ok_abs[:, j] & (nm == 0)) | bank_j
-            in_b = jnp.where(nm == 0, clk < st.boundary, rel < qps)
+            # Mid-chain run-ahead exists only to DISCOVER the rest of
+            # the chain: once the bank is full, the tile stalls for the
+            # resolve pass instead of retiring further hits against
+            # going-stale probes (they cost the same rounds after the
+            # drain, re-probed against post-serve state).
+            in_b = jnp.where(nm == 0, clk < st.boundary,
+                             (rel < qps) & (nm < P))
         else:
             bank_j = jnp.zeros(T, dtype=bool)
             okj = ok_rel[:, j] | ok_abs[:, j]
@@ -560,37 +668,16 @@ def _block_retire(params: SimParams, st: SimState,
             new_word, mode="drop"))
         return cache, vic_tag, vic_state
 
-    if P > 0 or not shared_l2:
-        l1d, vicD_tag, vicD_state = _apply_fills(
-            l1d, fill_d | fill_bank_d, pD,
+    if not shared_l2:
+        # Banked misses do NOT fill here — the resolve pass installs the
+        # line at serve time (blocking semantics), choosing its victim
+        # against the post-serve cache state.
+        l1d, _, _ = _apply_fills(
+            l1d, fill_d, pD,
             jnp.where(is_wr, M, S).astype(jnp.int32), params.l1d)
-        l1i, vicI_tag, vicI_state = _apply_fills(
-            l1i, fill_i | fill_bank_i, pI,
+        l1i, _, _ = _apply_fills(
+            l1i, fill_i, pI,
             jnp.full((T, K), S, dtype=jnp.int32), params.l1i)
-
-    if P > 0 and not shared_l2:
-        # Banked-miss installs into the private L2 (way/victim chosen
-        # pre-loop from window-start rows; distinct sets per window by the
-        # hazard rules).
-        l2_fill_act = l2_fill_cand & retired & enb
-        l2_new_state = jnp.where(is_comp, S,
-                                 jnp.where(is_wr, M, S)).astype(jnp.int32)
-        new_word2 = cachemod.pack_word(line.astype(jnp.int32), stamp,
-                                       l2_new_state)
-        rows2 = jnp.broadcast_to(rows[:, None], (T, K))
-        l2 = l2._replace(word=l2.word.at[
-            fway2, jnp.where(l2_fill_act, rows2, T), pL2.set_idx].set(
-            new_word2, mode="drop"))
-        if params.l2.replacement == "round_robin":
-            adv2 = l2_fill_act & ~pL2.hit
-            l2 = l2._replace(rr_ptr=l2.rr_ptr.at[
-                jnp.where(adv2, rows2, T), pL2.set_idx].set(
-                (rr2 + 1) % A2, mode="drop"))
-        # Inclusion: the L2 victim's L1D copy drops now (the directory
-        # learns of the eviction when the banked element is served).
-        l1d = cachemod.invalidate_by_value(
-            l1d, l2_vic_tag, l2_fill_act & (l2_vic_state != I),
-            jnp.full((T, K), I, dtype=jnp.int32))
 
     # ---- branch-predictor table: last retired write per slot wins
     bp_table = st.bp_table
@@ -627,11 +714,14 @@ def _block_retire(params: SimParams, st: SimState,
         icount=c.icount + msum(is_comp, icount_ev)
         + msum((is_mem & ((arg2 & 0xFF) == 0)) | is_br),
         l1i_access=c.l1i_access + msum(is_comp, icount_ev) + msum(is_br),
-        l1i_miss=c.l1i_miss + msum(is_comp & ~pI.hit, n_lines),
+        # Forwarded re-accesses are the hits the oracle counts after the
+        # fill, not fresh misses.
+        l1i_miss=c.l1i_miss + msum(is_comp & ~pI.hit & ~comp_fwd, n_lines),
         l1d_read=c.l1d_read + msum(is_rd),
-        l1d_read_miss=c.l1d_read_miss + msum(is_rd & ~l1_ok),
+        l1d_read_miss=c.l1d_read_miss + msum(is_rd & ~l1_ok & ~mem_fwd),
         l1d_write=c.l1d_write + msum(is_wr),
-        l1d_write_miss=c.l1d_write_miss + msum(is_wr & ~l1_ok),
+        l1d_write_miss=c.l1d_write_miss
+        + msum(is_wr & ~l1_ok & ~mem_fwd),
         l2_access=c.l2_access if shared_l2
         else c.l2_access + msum(mem_l2 | comp_l2 | l2_fill_cand),
         l2_miss=c.l2_miss if shared_l2
@@ -661,13 +751,6 @@ def _block_retire(params: SimParams, st: SimState,
         kind_ev = jnp.where(is_comp, PEND_IFETCH,
                             jnp.where(is_wr, PEND_EX_REQ, PEND_SH_REQ))
         req_val = kind_ev.astype(jnp.int64) | (line << 8)
-        if shared_l2:
-            vic_tag_v = jnp.where(is_comp, vicI_tag, vicD_tag)
-            vic_state_v = jnp.where(is_comp, vicI_state, vicD_state)
-        else:
-            vic_tag_v = l2_vic_tag
-            vic_state_v = l2_vic_state
-        vic_val = vic_state_v.astype(jnp.int64) | (vic_tag_v << 3)
         # Local cost folded into the served completion (complex-slot
         # `extra` math): a blocked COMPUTE's execution + fetch time minus
         # the remotely fetched first line; memory operands owe nothing
@@ -688,7 +771,6 @@ def _block_retire(params: SimParams, st: SimState,
 
         st = st._replace(
             mq_req=put(st.mq_req, req_val),
-            mq_victim=put(st.mq_victim, vic_val),
             mq_delta=put(st.mq_delta, bank_delta),
             mq_extra=put(st.mq_extra, extra_val),
             mq_count=nm,
@@ -1101,8 +1183,9 @@ def _complex_slot(params: SimParams, state: SimState,
     pend_extra = jnp.where(blocked, extra, st.pend_extra)
 
     # ---- bank the miss as chain element 0 (P > 0; the complex slot only
-    # runs on an empty chain, so slot 0 is free) and install the line
-    # locally — the same optimistic-install semantics as the window path.
+    # runs on an empty chain, so slot 0 is free).  No local install —
+    # the resolve pass fills the line at serve time (blocking
+    # semantics), same as the window path.
     if P > 0:
         kind_ev = jnp.where(comp_block, PEND_IFETCH,
                             jnp.where(is_wr, PEND_EX_REQ,
@@ -1119,7 +1202,6 @@ def _complex_slot(params: SimParams, state: SimState,
     # ------------------------------------------------- cache updates
     l1i = cachemod.touch(st.l1i, pI.set_idx, pI.way, is_comp & pI.hit & en,
                          _row_word(pI.row, pI.way), stamp)
-    mq_victim0 = jnp.zeros(T, dtype=jnp.int64)
     if shared_l2:
         l2 = st.l2
         d_word = _row_word(pD.row, pD.way)
@@ -1130,20 +1212,6 @@ def _complex_slot(params: SimParams, state: SimState,
                                   M, pD.state))
         l1d = cachemod.touch(st.l1d, pD.set_idx, pD.way, mem_l1,
                              d_word, stamp)
-        if P > 0:
-            # Banked-miss installs (L1-only under shared L2).
-            fDb = cachemod.fill(l1d, line,
-                                jnp.where(is_wr, M, S).astype(jnp.int32),
-                                bank & mem_rem, params.l1d.num_sets,
-                                params.l1d.replacement, stamp)
-            l1d = fDb.cache
-            fIb = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
-                                bank & comp_block, params.l1i.num_sets,
-                                params.l1i.replacement, stamp)
-            l1i = fIb.cache
-            vtag0 = jnp.where(comp_block, fIb.victim_tag, fDb.victim_tag)
-            vst0 = jnp.where(comp_block, fIb.victim_state, fDb.victim_state)
-            mq_victim0 = vst0.astype(jnp.int64) | (vtag0 << 3)
     else:
         fI = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
                            comp_l2path, params.l1i.num_sets,
@@ -1162,32 +1230,6 @@ def _complex_slot(params: SimParams, state: SimState,
                            mem_l2, params.l1d.num_sets,
                            params.l1d.replacement, stamp)
         l1d = fD.cache
-        if P > 0:
-            # Banked-miss installs: L2 (victim recorded for resolve's
-            # directory notify) then L1D/L1I; the L2 victim's L1 copy
-            # drops now (inclusion).
-            f2b = cachemod.fill(l2, line,
-                                jnp.where(comp_block, S,
-                                          jnp.where(is_wr, M, S)).astype(
-                                              jnp.int32),
-                                bank, params.l2.num_sets,
-                                params.l2.replacement, stamp)
-            l2 = f2b.cache
-            mq_victim0 = f2b.victim_state.astype(jnp.int64) \
-                | (f2b.victim_tag << 3)
-            l1d = cachemod.invalidate_by_value(
-                l1d, f2b.victim_tag[:, None],
-                (bank & (f2b.victim_state != I))[:, None],
-                jnp.full((T, 1), I, dtype=jnp.int32))
-            fDb = cachemod.fill(l1d, line,
-                                jnp.where(is_wr, M, S).astype(jnp.int32),
-                                bank & mem_rem, params.l1d.num_sets,
-                                params.l1d.replacement, stamp)
-            l1d = fDb.cache
-            fIb = cachemod.fill(l1i, line, jnp.full(T, S, dtype=jnp.int32),
-                                bank & comp_block, params.l1i.num_sets,
-                                params.l1i.replacement, stamp)
-            l1i = fIb.cache
 
     # ------------------------------------------------------- counters
     # (all gated on the ROI flag: outside it nothing accumulates)
@@ -1291,8 +1333,6 @@ def _complex_slot(params: SimParams, state: SimState,
         st = st._replace(
             mq_req=st.mq_req.at[0].set(
                 jnp.where(bank, mq_req0, st.mq_req[0])),
-            mq_victim=st.mq_victim.at[0].set(
-                jnp.where(bank, mq_victim0, st.mq_victim[0])),
             mq_delta=st.mq_delta.at[0].set(
                 jnp.where(bank, mq_delta0, st.mq_delta[0])),
             mq_extra=st.mq_extra.at[0].set(
@@ -1301,6 +1341,47 @@ def _complex_slot(params: SimParams, state: SimState,
             chain_rel=chain_rel,
         )
     return st
+
+
+def _complex_slot_guarded(params: SimParams, state: SimState,
+                          trace: TraceArrays) -> SimState:
+    """Run the general slot only when some tile can use it (P > 0): a
+    mid-chain tile waits for resolve, so on miss-dominated stretches the
+    slot's gathers/scatters — a whole engine round — would execute as a
+    pure no-op between every pair of banking rounds.  The guard is the
+    slot's own active mask, so skipping is result-identical; at P == 0
+    the slot runs unconditionally (bit-identity with the seed engine)."""
+    if params.miss_chain <= 0:
+        return _complex_slot(params, state, trace)
+    N = trace.num_events
+    eligible = (~state.done) & (state.pend_kind == PEND_NONE) \
+        & (state.clock < state.boundary) & (state.cursor < N) \
+        & (state.mq_count == 0)
+    # The window phase retires (or banks) every simple-class event, so
+    # the general slot is needed only when an ELIGIBLE tile's next event
+    # is one the window never takes: sync/thread/network/lifecycle ops,
+    # atomics, syscalls, DVFS, DONE, ROI flips — or when models are off
+    # (the window retires nothing then) or under iocoom (annotated
+    # events decline the window).  One [T] op gather decides; skipping
+    # saves a whole engine round between every pair of banking rounds
+    # on miss-dominated stretches.  With the window phase DISABLED
+    # (block_events = 0) the general slot is the only executor, so the
+    # op-class refinement must not apply (it would deadlock every
+    # simple-class event).
+    if params.core.model != "iocoom" and params.block_events > 0:
+        cur = jnp.minimum(state.cursor, N - 1)
+        srow = state.seat_stream if state.sched_enabled \
+            else jnp.arange(params.num_tiles)
+        op = trace.meta[0, srow, cur]
+        window_class = ((op == EventOp.COMPUTE) | (op == EventOp.BRANCH)
+                        | (op == EventOp.MEM_READ)
+                        | (op == EventOp.MEM_WRITE)
+                        | (op == EventOp.STALL) | (op == EventOp.SYNC)
+                        | (op == EventOp.SPAWN))
+        eligible = eligible & (~window_class | ~state.models_enabled)
+    return jax.lax.cond(
+        eligible.any(),
+        lambda s: _complex_slot(params, s, trace), lambda s: s, state)
 
 
 def local_advance(params: SimParams, state: SimState,
@@ -1315,7 +1396,62 @@ def local_advance(params: SimParams, state: SimState,
     Progress sums are hoisted into the loop carries (one cursor-sum
     reduction per round, computed in the body; conds compare scalars) —
     the old cond/body pairs each re-swept the [T] cursor array, doubling
-    the reduction count on the engine's innermost loops."""
+    the reduction count on the engine's innermost loops.
+
+    Chain cadence (P > 0): just enough window rounds to fill the bank
+    (one for a wide window, a few for a narrow one) + one (guarded)
+    general slot per call — banking interleaves with serving at
+    sub-round granularity instead of filling whole chains first.  Tiles
+    bank ~a chain of misses, the very next resolve pass replays them,
+    and the window resumes against post-serve state; nobody sits
+    full-chain-stalled while a straggler keeps the local loop alive
+    (the round-7 profile: that wait was most of the window-round
+    count), and the run-ahead staleness window shrinks to one
+    sub-round.  The sub-round loop in quantum_step supplies the
+    iteration that the local loop supplies at P == 0."""
+    if params.miss_chain > 0:
+        if params.block_events > 0:
+            # Enough window rounds per sub-round to fill the chain bank
+            # at the miss-dominated worst case (~2 local events per
+            # bankable miss), capped small so serves stay fresh; the
+            # loop still exits the moment a round retires nothing, and
+            # is skipped OUTRIGHT when no tile can possibly retire (all
+            # candidates chain-full or past the quantum boundary — the
+            # window's own in_b gate would mask every event, so the
+            # skip is result-identical and saves the probe round).
+            K = params.block_events
+            cap_w = max(1, -(-params.miss_chain * 3 // (2 * K)))
+            N = trace.num_events
+            qps = jnp.int64(params.quantum_ps)
+
+            def wprog(st):
+                return jnp.sum(st.cursor.astype(jnp.int64))
+
+            def wcond(c):
+                j, pv, cv, _s = c
+                return (j < cap_w) & ((j == 0) | (cv > pv))
+
+            def wbody(c):
+                j, _pv, cv, s = c
+                s = _block_retire(params, s, trace)
+                return j + 1, cv, wprog(s), s
+
+            def wloop(st):
+                _, _, _, out = jax.lax.while_loop(
+                    wcond, wbody,
+                    (jnp.int32(0), jnp.int64(-1), wprog(st), st))
+                return out
+
+            mid = state.mq_count > 0
+            can_retire = (~state.done) & (state.pend_kind == PEND_NONE) \
+                & (state.cursor < N) \
+                & jnp.where(mid,
+                            (state.chain_rel < qps)
+                            & (state.mq_count < params.miss_chain),
+                            state.clock < state.boundary)
+            state = jax.lax.cond(can_retire.any(), wloop,
+                                 lambda s: s, state)
+        return _complex_slot_guarded(params, state, trace)
 
     def progress(st):
         return jnp.sum(st.cursor.astype(jnp.int64))

@@ -298,15 +298,28 @@ def quantum_step(params: SimParams, state: SimState,
     if state.sched_enabled:
         state = schedule_rotate(params, state)
 
+    # Chain cadence (tpu/miss_chain > 0): local_advance is ONE window
+    # round + a guarded general slot, so the sub-round loop here is what
+    # alternates banking with resolve passes — its cap must admit a full
+    # quantum's worth of window rounds, and the progress metric must see
+    # mid-chain serves (they move neither cursor nor clock until the
+    # chain drains; the memory-stall counter strictly increases on every
+    # served element, so it is the monotone witness).
+    P = params.miss_chain
+    cap = params.rounds_per_quantum if P == 0 \
+        else max(params.rounds_per_quantum, params.max_events_per_quantum)
+
     def progress(st):
         # cursor moves on any retire/bank/unblock; clock moves when a
         # resolve pass drains a miss chain without retiring new events.
-        return jnp.sum(st.cursor.astype(jnp.int64)) + jnp.sum(st.clock)
+        p = jnp.sum(st.cursor.astype(jnp.int64)) + jnp.sum(st.clock)
+        if P > 0:
+            p = p + jnp.sum(st.counters.mem_stall_ps)
+        return p
 
     def cond(carry):
         i, prev, cur, _st = carry
-        return (i < params.rounds_per_quantum) \
-            & ((i == 0) | (cur > prev))
+        return (i < cap) & ((i == 0) | (cur > prev))
 
     def body(carry):
         i, _prev, cur, st = carry

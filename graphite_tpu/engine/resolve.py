@@ -192,435 +192,528 @@ def _unblock(state: SimState, mask, completion, sync: bool) -> SimState:
 
 # ===================================================================== memory
 
-def _cumsum_p(x: jnp.ndarray) -> jnp.ndarray:
-    """Inclusive prefix sum along axis 0 via doubling (log2 P shifted
-    adds; XLA:TPU lowers int64 cumsum to reduce-window — see
-    queue_models._cumsum_doubling)."""
-    v = x
-    d = 1
-    Pn = x.shape[0]
-    while d < Pn:
-        pad = jnp.zeros((d,) + x.shape[1:], x.dtype)
-        v = v + jnp.concatenate([pad, v[:-d]], axis=0)
-        d *= 2
-    return v
+def chain_fast_pass(params: SimParams, state: SimState, H: int,
+                    ftbl: jnp.ndarray):
+    """Serve whole banked miss chains in ONE resolve pass with BLOCKING
+    semantics — the round-7 throughput core (PROFILE.md lever 1).
 
+    The conflict-round loop below serves one chain element per tile per
+    round, so its round count equals the longest chain — ~one engine
+    round per miss, the round-3 wall-clock floor.  This pass instead
+    replays each tile's chain SEQUENTIALLY inside one engine round: a
+    bounded ``lax.fori_loop`` of P iterations prices and applies every
+    tile's CURRENT chain head together, so element k+1 probes the
+    directory state element k (and the other tiles' already-served
+    elements) wrote, and installs its line into the requester's caches
+    at serve time — the same math, the same election tables, and the
+    same scatters as one conflict round.  Rounds needed ~= misses /
+    chain instead of misses.
 
-def chain_fast_pass(params: SimParams, state: SimState) -> SimState:
-    """Price and apply every NON-CONFLICTING banked chain element in ONE
-    [P, T] pass — the round-4 throughput core.
+    Cross-tile same-line requests serialize through the SLOT AXIS: when
+    tile A banked line X at slot 3 and tile B at slot 7, iteration 3
+    installs A's grant and iteration 7's probe finds it — B pays the
+    owner flush / upgrade transition against A's entry plus X's
+    serialization floor, exactly as two consecutive conflict rounds
+    would price it (service order follows chain position rather than
+    the two issue times; the floor keeps the timing serialized either
+    way, and the 2% oracle gate bounds the residual inversion skew).
+    Owner flush/downgrade legs are priced in-pass — the round loop's
+    zero-load unicast math, with owner-side downgrades delivered
+    through the same J_OWN-budgeted per-target line lists — because
+    single-owner migratory sharing (every radix permute write)
+    dominates contended miss traffic.
 
-    The conflict-round loop serves one chain element per tile per round,
-    so its total round count equals the longest miss chain — ~one device
-    round per miss, the round-3 engine's wall-clock floor.  But almost
-    all requests in real traces are independent: distinct lines, trivial
-    directory transitions (SH on I/S, EX on I or on an entry the
-    requester already owns), no invalidation fan-out, no owner legs.
-    This pass detects exactly those, prices whole chains with two prefix
-    sums (zero-load round trips + a one-iteration DRAM-queue correction),
-    and applies all directory/counter effects with a handful of batched
-    scatters.  Each tile's chain is served up to its first non-fast
-    element (chain order is a strict prefix); everything after stays
-    banked for the exact conflict-round loop that follows.
+    Conflict fallback: a chain stops at its first element whose
+    transition needs machinery this replay does not carry —
+    invalidation fan-out (EX against multi-sharer entries), live
+    directory-victim entries, per-owner delivery-budget overflow, or a
+    same-iteration (home, dset, way)-election loss (which covers two
+    tiles banking one line at the SAME slot index).  From that element
+    on the chain stays banked for the exact one-element-per-round loop
+    that follows, so fan-out traffic always goes through the same
+    budgeted FCFS election the one-parked-request oracle applies —
+    which is what makes this a fast path and not a different machine
+    (the round-4 attempt installed lines optimistically at bank time
+    and modeled a non-blocking MSHR core; the de-xfailed equality
+    tests in tests/test_chain_equivalence.py are the gate).
 
-    Approximations vs the round loop: DRAM queue delays are computed
-    against pre-correction arrival times (one fixpoint iteration), and
-    same-(home,dset) allocation ranks order by chain position rather
-    than exact issue time.  Simple in-order cores only (iocoom chains
-    thread their LQ/SQ rings through the round loop).
+    The serialization-floor table ``ftbl`` is shared with the round
+    loop: the pass WRITES the floors its services create, so leftover
+    round-loop elements (the genuinely concurrent contenders) observe
+    fast-served lines' data-availability times; it does not READ floors
+    itself — in-pass same-line successors are serialized by the
+    directory-state replay (owner flush / upgrade against the
+    predecessor's entry), which is how the oracle prices the same pair
+    across two of its passes (see the ``arrive`` note in the body).
 
-    STATUS — a different MACHINE, not a fast path (round-5 finding).
-    tests/test_chain_equivalence.py measures this engine against the
-    one-parked-request oracle, and the divergence is not a pricing bug:
-    banking lets the block window run past L2 misses, so later accesses
-    reach lines BEFORE other tiles' invalidations land — on the radix-8
-    probe the chain engine sees 141 EX directory requests where the
-    blocking oracle sees 347 (and 60 vs 262 writebacks).  That is the
-    correct behavior of a non-blocking hit-under-miss core with P MSHRs,
-    which is what ``tpu/miss_chain = P`` now officially models — the
-    reference has no such core model (its IOCOOM stalls on use,
-    iocoom_core_model.cc), so there is no parity target to match.
-    Because the blocking SimpleCoreModel is the reference-parity
-    configuration, ``tpu/miss_chain`` defaults to 0; the equivalence
-    tests stay as xfail documentation of the intended behavioral gap on
-    contended traces (on conflict-free traces the two engines agree).
+    Restrictions (the round loop serves everything instead): simple
+    in-order cores (iocoom threads its LQ/SQ rings through the round
+    loop), full_map directories (limited schemes take per-request
+    pointer/trap actions that must serialize), and uncontended NoC
+    models (emesh_hop_by_hop link flights thread per-link horizons
+    through every leg in round order).
     """
     P = params.miss_chain
     T = params.num_tiles
     A = params.directory.associativity
     W = state.dir_sharers.shape[0] // A
     ndsets = params.directory.num_sets
-    R = P * T
-    H2 = 1 << (4 * R - 1).bit_length()          # line-group table size
-    rows_t = jnp.arange(T)
-    slots = jnp.arange(P, dtype=jnp.int32)[:, None]            # [P, 1]
-    tile_of = jnp.broadcast_to(rows_t[None, :], (P, T)).astype(jnp.int32)
+    rows = jnp.arange(T)
     shared_l2 = params.shared_l2
-    full_map = params.directory.directory_type == "full_map"
+    head0 = state.mq_head
+    stop_hi = state.mq_count
 
-    head = state.mq_head
-    valid = (slots >= head[None, :]) & (slots < state.mq_count[None, :])
-    req = state.mq_req
-    line = jnp.where(valid, req >> 8, -1 - slots.astype(jnp.int64))
-    kind = (req & 7).astype(jnp.int32)
-    is_ex = valid & (kind == PEND_EX_REQ)
-    is_if = valid & (kind == PEND_IFETCH)
-    home = home_of_line(params, jnp.maximum(line, 0))
-    dset = dir_set_of_line(params, jnp.maximum(line, 0))
-    fidx = (home * ndsets + dset).astype(jnp.int32)
-    line32 = line.astype(jnp.int32)
-
-    # ---- directory probe: one [A, P, T] gather
-    drow = state.dir_word[:, fidx]
-    dstate = dword_state(drow)
-    match = (dword_tag(drow) == line32[None]) & (dstate != I)
-    hit = match.any(axis=0) & valid
-    hway = jnp.argmax(match, axis=0).astype(jnp.int32)
-    invalid_w = dstate == I
-    has_inv_w = invalid_w.any(axis=0)
-    first_inv = jnp.argmax(invalid_w, axis=0).astype(jnp.int32)
-    lru_way = jnp.argmin(dword_stamp(drow), axis=0).astype(jnp.int32)
-
-    # ---- line groups (combining + conflict detection), hash tables over
-    # all R elements; lmin/lmax verify make hash collisions conservative.
-    hsl = (dense.fmix64(line) % jnp.uint64(H2)).astype(jnp.int32)
-    hsl_v = jnp.where(valid, hsl, H2)
-    flat_r = (slots * T + rows_t[None, :]).astype(jnp.int32)   # [P, T]
-    cnt_t = jnp.zeros((H2,), jnp.int32).at[hsl_v].add(1, mode="drop")
-    ex_t = jnp.zeros((H2,), bool).at[
-        jnp.where(is_ex, hsl, H2)].set(True, mode="drop")
-    lmin_t = jnp.full((H2,), 2**62, jnp.int64).at[hsl_v].min(
-        line, mode="drop")
-    lmax_t = jnp.full((H2,), -2**62, jnp.int64).at[hsl_v].max(
-        line, mode="drop")
-    rep_t = jnp.full((H2,), R, jnp.int32).at[hsl_v].min(
-        flat_r, mode="drop")
-    multi = valid & (cnt_t[hsl] > 1)
-    mixed = valid & (lmin_t[hsl] != lmax_t[hsl])
-    is_rep = valid & (rep_t[hsl] == flat_r)
-
-    # ---- victim way for allocating reps: ranked within (home, dset)
-    # groups by chain position (invalid ways first, then stamp-LRU, ways
-    # held by hits excluded); rank overflow defers to the round loop.
-    fh = (dense.fmix64(fidx.astype(jnp.int64))
-          % jnp.uint64(H2)).astype(jnp.int32)
-    used_t = jnp.zeros((H2, A), bool).at[
-        jnp.where(hit, fh, H2), hway].set(True, mode="drop")
-    hway_used = used_t[fh]                                     # [P, T, A]
-    alloc_cand = valid & ~hit & is_rep
-    grank = _grouped_rank(fidx.reshape(R), flat_r.reshape(R).astype(
-        jnp.int64), alloc_cand.reshape(R)).reshape(P, T)
-    NEVER = jnp.int32(2**31 - 1)
-    dstampw = dword_stamp(drow).transpose(1, 2, 0)             # [P, T, A]
-    vkey = jnp.where(hway_used, NEVER,
-                     jnp.where(invalid_w.transpose(1, 2, 0), -1, dstampw))
-    eligible = ~hway_used
-    arA = jnp.arange(A, dtype=jnp.int32)
-    pos = jnp.sum(
-        eligible[..., None, :]
-        & ((vkey[..., None, :] < vkey[..., :, None])
-           | ((vkey[..., None, :] == vkey[..., :, None])
-              & (arA[None, None, None, :] < arA[None, None, :, None]))),
-        axis=3).astype(jnp.int32)                              # [P, T, A]
-    n_elig = jnp.sum(eligible, axis=2).astype(jnp.int32)
-    miss_way = jnp.argmax(eligible & (pos == grank[..., None]),
-                          axis=2).astype(jnp.int32)
-    can_alloc = alloc_cand & (grank < n_elig)
-    way = jnp.where(hit, hway, miss_way)
-
-    # ---- transition (flattened [R] view — elementwise + [R, W] bitmaps)
-    way_word = jnp.take_along_axis(
-        drow, way[None], axis=0)[0]                            # [P, T]
-    way_state = dword_state(way_word)
-    entry_state = jnp.where(hit, way_state, I)
-    entry_owner = jnp.where(hit, dword_owner(way_word), -1)
-    shar_rows = state.dir_sharers[:, fidx].reshape(W, A, P, T)
-    entry_sharers = jnp.where(
-        hit[None], jnp.take_along_axis(
-            shar_rows, way[None, None], axis=1)[:, 0], jnp.uint64(0))
-    entry_sharers_r = entry_sharers.reshape(W, R).T            # [R, W]
-    act = dirmod.transition(
-        params.protocol_kind, is_ex.reshape(R), tile_of.reshape(R),
-        entry_state.reshape(R), entry_owner.reshape(R), entry_sharers_r,
-        W, is_ifetch=is_if.reshape(R))
-    owner_leg = act.owner_leg.reshape(P, T)
-    has_invs = (act.inv_targets != jnp.uint64(0)).any(
-        axis=1).reshape(P, T)
-    need_read_e = act.dram_read.reshape(P, T)
-
-    # ---- directory-victim entry of allocating reps: fast only when it
-    # needs no traffic (I, or S/O with an empty sharer bitmap).
-    vic_e_state = jnp.where(can_alloc, way_state, I)
-    vic_e_sharers = jnp.where(
-        can_alloc[None], jnp.take_along_axis(
-            shar_rows, way[None, None], axis=1)[:, 0], jnp.uint64(0))
-    vic_e_live_traffic = (vic_e_state == M) | (vic_e_state == E) \
-        | (vic_e_sharers != jnp.uint64(0)).any(axis=0)
-    evicting = can_alloc & (vic_e_state != I)
-
-    # ---- combining (all-SH line groups against I/S entries, full_map)
-    if full_map:
-        sh_entry_ok = (entry_state == I) | (entry_state == S)
-        if shared_l2:
-            sh_entry_ok = sh_entry_ok & (entry_state != I)
-        combine = multi & ~mixed & ~ex_t[hsl] & ~is_ex & sh_entry_ok
-    else:
-        combine = jnp.zeros_like(multi)
-    member = combine & ~is_rep
-    # Members adopt their rep's way (written once by the rep).
-    way_rep_t = jnp.zeros((H2,), jnp.int32).at[
-        jnp.where(is_rep, hsl, H2)].set(way, mode="drop")
-    way = jnp.where(member, way_rep_t[hsl], way)
-
-    # ---- FAST classification
-    fast = valid & ~owner_leg & ~has_invs \
-        & (hit | member | (can_alloc & ~vic_e_live_traffic)) \
-        & (~multi | combine) & ~mixed
-    # A member is only fast if its rep is (checked after the prefix
-    # cutoff below, iterated to a fixpoint).
-
-    # ---- prefix cutoff: serve each chain up to its first non-fast
-    # element; a combining member whose rep got cut goes slow too.
-    first_slow = jnp.min(jnp.where(valid & ~fast, slots, P),
-                         axis=0).astype(jnp.int32)             # [T]
-    for _ in range(3):
-        served = valid & (slots < first_slow[None, :])
-        rep_srv_t = jnp.zeros((H2,), bool).at[
-            jnp.where(is_rep & served, hsl, H2)].set(True, mode="drop")
-        bad_member = member & served & ~rep_srv_t[hsl]
-        first_slow = jnp.minimum(first_slow, jnp.min(
-            jnp.where(bad_member, slots, P), axis=0).astype(jnp.int32))
-    served = valid & (slots < first_slow[None, :])
-    rep_srv = is_rep & served
-    n_new = jnp.maximum(first_slow - head, 0)
-
-    # ---- timing: zero-load chain prefix + one-pass DRAM correction
+    # ---- per-tile constants of the pass (clock periods only change in
+    # a complex slot, never mid-resolve)
     p_net = _period(state, DVFSModule.NETWORK_MEMORY)
     p_dir = _period(state, DVFSModule.L2_CACHE if shared_l2
                     else DVFSModule.DIRECTORY)
     p_l2 = _period(state, DVFSModule.L2_CACHE)
     p_l1d = _period(state, DVFSModule.L1_DCACHE)
     p_l1i = _period(state, DVFSModule.L1_ICACHE)
-    p_net_home = p_net[home]
-    net_req = noc.unicast_ps(params.net_memory, tile_of, home, CTRL_BYTES,
-                             p_net[None, :], params.mesh_width)
-    reply_ps = noc.unicast_ps(params.net_memory, home, tile_of,
-                              params.line_size + CTRL_BYTES, p_net_home,
-                              params.mesh_width)
-    dir_ps = _lat(params.directory.access_cycles, p_dir[home])
     dram_access_ps = jnp.int64(params.dram.latency_ps)
     dram_service_ps = jnp.int64(
         params.dram.processing_ps_per_line(params.line_size))
-    l1_fill_ps = jnp.where(
-        is_if, _lat(params.l1i.access_cycles, p_l1i[None, :]),
-        _lat(params.l1d.access_cycles, p_l1d[None, :]))
-    if shared_l2:
-        dsite = dram_site_of_line(params, jnp.maximum(line, 0))
-        local_ctl = home == dsite
-        to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
-            params.net_memory, home, dsite, CTRL_BYTES, p_net_home,
-            params.mesh_width))
-        from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
-            params.net_memory, dsite, home,
-            params.line_size + CTRL_BYTES, p_net[dsite],
-            params.mesh_width))
-        fill_ps = l1_fill_ps
-    else:
-        dsite = home
-        to_dram_ps = from_dram_ps = jnp.int64(0)
-        fill_ps = _lat(params.l2.access_cycles, p_l2[None, :]) + l1_fill_ps
-    need_read = need_read_e & served
-    dram_leg = jnp.where(need_read_e,
-                         to_dram_ps + dram_access_ps + dram_service_ps
-                         + from_dram_ps, 0)
-    rt0 = net_req + dir_ps + dram_leg + reply_ps + fill_ps \
-        + state.mq_extra
-    # completion_k = base0 + sum_{head<=j<=k} (delta_j + rt0_j)
-    step = jnp.where(valid, state.mq_delta + rt0, 0)
-    base0 = jnp.where(head == 0, 0, state.chain_base)
-    comp0 = base0[None, :] + _cumsum_p(step)                   # [P, T]
-    issue0 = comp0 - rt0
-
-    # DRAM-queue correction against pre-correction arrivals; each tile's
-    # later elements inherit its earlier elements' delays (prefix).
-    if params.dram.queue_model_enabled:
-        arr = issue0 + net_req + dir_ps + to_dram_ps
-        _, _, delay_f, rs_, re_, rp_, mg1_ = queue_models.probe(
-            params.dram.queue_model_type,
-            dsite.reshape(R), arr.reshape(R),
-            jnp.full((R,), dram_service_ps), need_read.reshape(R),
-            state.dram_ring_start, state.dram_ring_end,
-            state.dram_ring_ptr, state.dram_qacc,
-            ma_window=params.dram.basic_ma_window)
-        delay = delay_f.reshape(P, T)
-        state = state._replace(dram_ring_start=rs_, dram_ring_end=re_,
-                               dram_ring_ptr=rp_, dram_qacc=mg1_)
-    else:
-        delay = jnp.zeros((P, T), jnp.int64)
-    cum_delay = _cumsum_p(jnp.where(served, delay, 0))
-    completion = comp0 + cum_delay
-    issue = issue0 + cum_delay - delay
-
-    # ---- apply: directory entries (reps + non-combined winners write
-    # their slot; distinct (home, dset, way) by construction)
-    writer = served & (is_rep | ~combine)
-    fidx_w = jnp.where(writer, fidx, jnp.int32(2**30))
-    state = state._replace(dir_word=state.dir_word.at[way, fidx_w].set(
-        dword_pack(jnp.maximum(line, 0), state.round_ctr,
-                   act.new_state.reshape(P, T),
-                   act.new_owner.reshape(P, T)), mode="drop"))
-    # Sharer bitmaps: writers land (new - old) per plane; combining
-    # members add their own bit (guarded: bit not already set).
-    new_sh = act.new_sharers.reshape(R, W).T.reshape(W, P, T)
-    old_row = jnp.where(hit[None], entry_sharers,
-                        jnp.where(can_alloc[None], vic_e_sharers,
-                                  jnp.uint64(0)))
-    delta_sh = new_sh - old_row
-    fidx_rep = jnp.where(writer, fidx, jnp.int32(2**30))
-    req_word = (tile_of // 64).astype(jnp.int32)
-    req_bit = jnp.uint64(1) << (tile_of % 64).astype(jnp.uint64)
-    own_word = jnp.take_along_axis(
-        entry_sharers.transpose(1, 2, 0), req_word[..., None],
-        axis=2)[..., 0]
-    member_add = member & served \
-        & ((own_word & req_bit) == jnp.uint64(0))
-    plane = jnp.arange(W, dtype=jnp.int32)[:, None, None] * A + way[None]
-    add_rows = jnp.concatenate(
-        [plane.reshape(-1), (req_word * A + way).reshape(-1)])
-    add_cols = jnp.concatenate(
-        [jnp.broadcast_to(fidx_rep[None], (W, P, T)).reshape(-1),
-         jnp.where(member_add, fidx, jnp.int32(2**30)).reshape(-1)])
-    add_vals = jnp.concatenate(
-        [delta_sh.reshape(-1), req_bit.reshape(-1)])
-    state = state._replace(dir_sharers=state.dir_sharers.at[
-        add_rows, add_cols].add(add_vals, mode="drop"))
-
-    # ---- banked-install victims: DRAM writeback occupancy for dirty
-    # ones + home-directory notify for live ones (same semantics as the
-    # round loop's chain-victim path).
-    cvic = state.mq_victim
-    vt = cvic >> 3
-    vs = (cvic & 7).astype(jnp.int32)
-    vic_live = served & (vs != I)
-    if shared_l2:
-        state = _sh_l1_evict_notify(
-            params, state, tile_of.reshape(R), vt.reshape(R),
-            vs.reshape(R), vic_live.reshape(R))
-        victim_dirty = vic_live & (vs == M)
-    else:
-        victim_dirty = served & ((vs == M) | (vs == O))
-        victim_home = dram_site_of_line(params, vt)
-        if params.dram.queue_model_enabled:
-            r3 = queue_models.occupy(
-                params.dram.queue_model_type,
-                state.dram_ring_start, state.dram_ring_end,
-                state.dram_ring_ptr, state.dram_qacc,
-                victim_home.reshape(R),
-                (issue0 + net_req + dir_ps).reshape(R), dram_service_ps,
-                victim_dirty.reshape(R),
-                ma_window=params.dram.basic_ma_window)
-            state = state._replace(dram_ring_start=r3[0],
-                                   dram_ring_end=r3[1],
-                                   dram_ring_ptr=r3[2], dram_qacc=r3[3])
-        state = _dir_evict_notify(
-            params, state, tile_of.reshape(R), vt.reshape(R),
-            vs.reshape(R), vic_live.reshape(R))
-
-    # ---- MESI slice E grant raises the banked S install in place
-    if params.protocol_kind == "sh_l2_mesi":
-        granted_e = served & ~is_ex \
-            & (act.new_state.reshape(P, T) == E)
-        state = state._replace(l1d=cachemod.raise_line_state(
-            state.l1d, tile_of.reshape(R), jnp.maximum(line, 0).reshape(R),
-            (granted_e & ~is_if).reshape(R), E, params.l1d.num_sets))
-
-    # ---- miss-type classification (fast pass sees no coherence
-    # take-aways, so inv marks stay; fills mark 'seen')
-    if params.track_miss_types:
-        HF = state.seen_filter.shape[1]
-        fslot = (dense.fmix64(line) % jnp.uint64(HF)).astype(jnp.int32)
-        key32 = (jnp.maximum(line, 0) + 1).astype(jnp.int32)
-        seen_v = state.seen_filter[tile_of, fslot] == key32
-        inv_v = state.inv_filter[tile_of, fslot] == key32
-        c0 = state.counters
-        state = state._replace(counters=c0._replace(
-            l2_miss_cold=c0.l2_miss_cold + jnp.sum(
-                served & ~inv_v & ~seen_v, axis=0),
-            l2_miss_capacity=c0.l2_miss_capacity + jnp.sum(
-                served & ~inv_v & seen_v, axis=0),
-            l2_miss_sharing=c0.l2_miss_sharing + jnp.sum(
-                served & inv_v, axis=0)))
-        state = state._replace(
-            seen_filter=state.seen_filter.at[
-                jnp.where(served, tile_of, T), fslot].set(
-                key32, mode="drop"),
-            inv_filter=state.inv_filter.at[
-                jnp.where(served & inv_v, tile_of, T), fslot].set(
-                0, mode="drop"))
-
-    # ---- counters
     flits_req = noc.num_flits(CTRL_BYTES, params.net_memory.flit_width_bits)
     flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
                                params.net_memory.flit_width_bits)
-    b = lambda m: m.astype(jnp.int64)
-    home_cols = [
-        b(served & ~is_ex), b(served & is_ex),      # dir_sh/ex_req
-        b(evicting & served),                       # dir_evictions
-        b(served),                                  # net_mem_pkts @home
-        jnp.where(served, flits_data, 0),           # net_mem_flits @home
-    ]
-    if shared_l2:
-        home_cols += [b(served), b(served & ~hit)]  # l2_access, l2_miss
-    hstack = jnp.stack([h.reshape(R) for h in home_cols], axis=1)
-    hb = jnp.zeros((T, hstack.shape[1]), dtype=jnp.int64).at[
-        home.reshape(R)].add(hstack)
-    db = jnp.zeros((T,), dtype=jnp.int64).at[
-        jnp.where(need_read, dsite, T).reshape(R)].add(
-        1, mode="drop")
-    if shared_l2:
-        vic_wr = 0
-    else:
-        vic_wr = jnp.zeros((T,), dtype=jnp.int64).at[
-            jnp.where(victim_dirty, victim_home, T).reshape(R)].add(
-            1, mode="drop")
-    c = state.counters
-    tsum = lambda m: jnp.sum(m, axis=0, dtype=jnp.int64)
-    c = c._replace(
-        dir_sh_req=c.dir_sh_req + hb[:, 0],
-        dir_ex_req=c.dir_ex_req + hb[:, 1],
-        dir_evictions=c.dir_evictions + hb[:, 2],
-        dram_reads=c.dram_reads + db,
-        dram_writes=c.dram_writes + vic_wr,
-        l2_access=c.l2_access + (hb[:, 5] if shared_l2 else 0),
-        l2_miss=c.l2_miss + (hb[:, 6] if shared_l2 else 0),
-        net_mem_pkts=c.net_mem_pkts + tsum(served) + tsum(victim_dirty)
-        + hb[:, 3],
-        net_mem_flits=c.net_mem_flits
-        + tsum(served) * flits_req + tsum(victim_dirty) * flits_data
-        + hb[:, 4],
-        mem_stall_ps=c.mem_stall_ps + jnp.sum(
-            jnp.where(served, completion - issue, 0), axis=0),
-    )
-    state = state._replace(counters=c)
+    rstamp = state.round_ctr * STAMP_STRIDE + STAMP_STRIDE - 1
 
-    # ---- chain bookkeeping: base = last served completion; drained
-    # chains restore the absolute clock.
-    any_srv = n_new > 0
-    last_idx = jnp.minimum(first_slow, state.mq_count) - 1
-    last_oh = slots == last_idx[None, :]
-    last_comp = jnp.sum(jnp.where(last_oh & served, completion, 0), axis=0)
-    new_base = jnp.where(any_srv, last_comp, state.chain_base)
-    drained = (state.mq_count > 0) & (first_slow >= state.mq_count)
+    def slot_body(p, carry):
+        # Each iteration serves every tile's CURRENT head (not the
+        # static slot p): an election loser retries the same element
+        # next iteration while the winner's chain moves on — lockstep
+        # tiles banking one boundary line at the same chain position
+        # lose one iteration instead of their whole tail, and the
+        # per-iteration FCFS election keeps in-pass service in issue
+        # order.  P iterations serve up to P elements per tile — the
+        # whole bank when nothing collides.
+        del p
+        state, stopped, head, base, ftbl = carry
+        hsel = jnp.clip(head, 0, max(P - 1, 0))[None, :]
+        req = jnp.take_along_axis(state.mq_req, hsel, axis=0)[0]   # [T]
+        delta = jnp.take_along_axis(state.mq_delta, hsel, axis=0)[0]
+        extra = jnp.take_along_axis(state.mq_extra, hsel, axis=0)[0]
+        active = (~stopped) & (head < stop_hi)
+        kind = (req & 7).astype(jnp.int32)
+        line = jnp.where(active, req >> 8, 0)
+        is_ex = active & (kind == PEND_EX_REQ)
+        is_if = active & (kind == PEND_IFETCH)
+        home = home_of_line(params, line)
+        dset = dir_set_of_line(params, line)
+        fidx = (home * ndsets + dset).astype(jnp.int32)
+        # Blocking chain composition: element p's issue point is the
+        # previous element's completion (the carried base) plus its
+        # recorded local delta.
+        issue = base + delta
+        hidx = (dense.fmix64(line) % jnp.uint64(H)).astype(jnp.int32)
+
+        # ---- directory probe at (home, dset) — post-predecessor state
+        drow = state.dir_word[:, fidx].T                       # [T, A]
+        dstate = dword_state(drow)
+        dstamp = dword_stamp(drow)
+        match = (dword_tag(drow) == line[:, None].astype(jnp.int32)) \
+            & (dstate != I)
+        hit = match.any(axis=1) & active
+        hway = jnp.argmax(match, axis=1).astype(jnp.int32)
+        invalid = dstate == I
+
+        # ---- victim way for allocs: invalid first, then stamp-LRU,
+        # ways held by this slot's hit elements excluded (hash table on
+        # the flat set id; a collision only stops a chain early)
+        fhash = (dense.fmix64(fidx.astype(jnp.int64))
+                 % jnp.uint64(H)).astype(jnp.int32)
+        used_tbl = jnp.zeros((H, A), dtype=bool).at[
+            jnp.where(hit, fhash, H), hway].set(True, mode="drop")
+        hway_used = used_tbl[fhash]                            # [T, A]
+        NEVER = jnp.int32(2**31 - 1)
+        vkey = jnp.where(hway_used, NEVER,
+                         jnp.where(invalid, -1, dstamp))
+        miss_way = jnp.argmin(vkey, axis=1).astype(jnp.int32)
+        can_alloc = active & ~hit & (jnp.take_along_axis(
+            vkey, miss_way[:, None], axis=1)[:, 0] != NEVER)
+        way = jnp.where(hit, hway, miss_way)
+
+        # ---- way-slot election: same-(home, dset) allocs in one slot
+        # pick the same victim way; the later element (FCFS by issue)
+        # stops its chain and retries through the round loop.
+        am = (home.astype(jnp.int64) * ndsets + dset) * A + way
+        aidx = (dense.fmix64(am) % jnp.uint64(H)).astype(jnp.int32)
+        packed = _fcfs_keys(active, issue)
+        wslot = _elect(active, packed, aidx, H)
+
+        # ---- transition against the replayed entry
+        way_word = jnp.take_along_axis(drow, way[:, None], axis=1)[:, 0]
+        way_state = dword_state(way_word)
+        way_owner = dword_owner(way_word)
+        dsharers = state.dir_sharers[:, fidx].reshape(
+            W, A, T).transpose(2, 1, 0)                        # [T, A, W]
+        entry_row = jnp.take_along_axis(
+            dsharers, way[:, None, None], axis=1)[:, 0, :]    # [T, W]
+        entry_state = jnp.where(hit, way_state, I)
+        entry_owner = jnp.where(hit, way_owner, -1)
+        entry_sharers = jnp.where(hit[:, None], entry_row,
+                                  jnp.zeros((T, W), dtype=jnp.uint64))
+        act = dirmod.transition(params.protocol_kind, is_ex, rows,
+                                entry_state, entry_owner, entry_sharers,
+                                W, is_ifetch=is_if)
+        has_inv = (act.inv_targets != jnp.uint64(0)).any(axis=1)
+        # Directory-victim entry must need no traffic (I, or S/O with an
+        # empty sharer bitmap) — live entries take the round loop's
+        # budgeted invalidation machinery.
+        vic_dead = (way_state == I) \
+            | (((way_state == S) | (way_state == O))
+               & (entry_row == jnp.uint64(0)).all(axis=1))
+        cand = active & wslot & ~has_inv & (hit | (can_alloc & vic_dead))
+        # Owner flush/downgrade legs serve here with the round loop's
+        # J_OWN per-target delivery budget (several requesters may name
+        # one owner tile); over-budget rows stop their chain instead.
+        owner = act.owner_tile
+        posr = _grouped_rank(owner, packed, cand & act.owner_leg)
+        serve = cand & ~(act.owner_leg & (posr >= J_OWN))
+        owner_leg = act.owner_leg & serve
+        evicting = serve & ~hit & (way_state != I)
+
+        # ---- SH combining within the slot (the round loop's combining,
+        # full_map): same-slot same-line SH requests against an I/S
+        # entry all lost the way election to their rep — serving them
+        # BESIDE it, each priced with its own un-floored trip, is
+        # exactly how the oracle's conflict round prices the group;
+        # bouncing them to the round loop alone made every follower
+        # wait out the rep's whole service through the serialization
+        # floor (measured 7% slow on the shared-readers probe).
+        sh_ok_e = (entry_state == I) | (entry_state == S)
+        if shared_l2:
+            sh_ok_e = sh_ok_e & (entry_state != I)
+        ex_any_t = jnp.zeros((H,), dtype=bool).at[
+            jnp.where(active & is_ex, hidx, H)].set(True, mode="drop")
+        rep_sh = serve & ~is_ex & sh_ok_e
+        rep_line_t = jnp.full((H,), -1, jnp.int64).at[
+            jnp.where(rep_sh, hidx, H)].set(line, mode="drop")
+        rep_way_t = jnp.zeros((H,), jnp.int32).at[
+            jnp.where(rep_sh, hidx, H)].set(way, mode="drop")
+        member = active & ~serve & ~is_ex & sh_ok_e & ~ex_any_t[hidx] \
+            & (rep_line_t[hidx] == line)
+        way = jnp.where(member, rep_way_t[hidx], way)
+        serve_all = serve | member
+        # Only transitions needing the round loop's machinery STOP a
+        # chain (invalidation fan-out, live directory victims, owner
+        # delivery-budget overflow); a plain way/line election loss
+        # retries at the next iteration.
+        hard_stop = active & ~serve_all \
+            & (has_inv | (can_alloc & ~vic_dead) | (~hit & ~can_alloc)
+               | (act.owner_leg & (posr >= J_OWN)))
+        stopped = stopped | hard_stop
+
+        # ---- timing: identical to the round loop's zero-load path for
+        # a fast element (owner/inv/evict legs are all zero by the
+        # serve conditions)
+        net_req = noc.unicast_ps(params.net_memory, rows, home,
+                                 CTRL_BYTES, p_net, params.mesh_width)
+        reply_ps = noc.unicast_ps(params.net_memory, home, rows,
+                                  params.line_size + CTRL_BYTES,
+                                  p_net[home], params.mesh_width)
+        dir_ps = _lat(params.directory.access_cycles, p_dir[home])
+        # No serialization-floor READ here: slot-axis same-line pairs are
+        # serialized by the directory-state replay itself (the later
+        # element pays the post-predecessor transition — owner flush /
+        # upgrade), which is how the oracle prices the SAME pair when it
+        # lands across two resolve passes; charging the floor ON TOP
+        # double-serialized concurrent readers the oracle combines and
+        # drifted migrate/readers probes 7-8% slow.  The pass still
+        # WRITES floors so round-loop leftovers (the genuinely
+        # concurrent class) serialize against in-pass services.
+        arrive = issue + net_req
+        t_dir = arrive + dir_ps
+        # Owner flush/downgrade round trip (zero-load unicast legs, the
+        # round loop's uncontended math; owner-side lookup in its
+        # private L2, or its L1D under shared L2).
+        p_net_own = p_net[owner]
+        if shared_l2:
+            l2_own_ps = _lat(params.l1d.access_cycles, p_l1d[owner])
+        else:
+            l2_own_ps = _lat(params.l2.access_cycles, p_l2[owner])
+        leg_ps = noc.unicast_ps(params.net_memory, home, owner,
+                                CTRL_BYTES, p_net[home],
+                                params.mesh_width) \
+            + l2_own_ps \
+            + noc.unicast_ps(params.net_memory, owner, home,
+                             params.line_size + CTRL_BYTES, p_net_own,
+                             params.mesh_width)
+        owner_ps = jnp.where(owner_leg, leg_ps, 0)
+        need_read = serve_all & act.dram_read
+        if shared_l2:
+            dsite = dram_site_of_line(params, line)
+            local_ctl = home == dsite
+            to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
+                params.net_memory, home, dsite, CTRL_BYTES, p_net[home],
+                params.mesh_width))
+            from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
+                params.net_memory, dsite, home,
+                params.line_size + CTRL_BYTES, p_net[dsite],
+                params.mesh_width))
+        else:
+            dsite = home
+            to_dram_ps = from_dram_ps = jnp.int64(0)
+        dram_arrival = t_dir + owner_ps + to_dram_ps
+        dram_wb = act.dram_write & serve_all
+        if params.dram.queue_model_enabled:
+            q_start, _, _, rs_, re_, rp_, mg1_ = queue_models.probe(
+                params.dram.queue_model_type,
+                dsite, dram_arrival, jnp.full(T, dram_service_ps),
+                need_read, state.dram_ring_start, state.dram_ring_end,
+                state.dram_ring_ptr, state.dram_qacc,
+                occ_res=dsite, occ_arr=dram_arrival,
+                occ_svc=jnp.full(T, dram_service_ps), occ_valid=dram_wb,
+                ma_window=params.dram.basic_ma_window)
+            state = state._replace(dram_ring_start=rs_, dram_ring_end=re_,
+                                   dram_ring_ptr=rp_, dram_qacc=mg1_)
+            dram_start = jnp.where(need_read, q_start, 0)
+        else:
+            dram_start = jnp.where(need_read, dram_arrival, 0)
+        dram_ready = dram_start + dram_access_ps + dram_service_ps \
+            + from_dram_ps
+        t_data = jnp.maximum(t_dir + owner_ps,
+                             jnp.where(need_read, dram_ready, 0))
+        reply_done = t_data + reply_ps
+        l1_fill_ps = jnp.where(
+            is_if, _lat(params.l1i.access_cycles, p_l1i),
+            _lat(params.l1d.access_cycles, p_l1d))
+        if shared_l2:
+            completion = reply_done + l1_fill_ps + extra
+        else:
+            completion = reply_done \
+                + _lat(params.l2.access_cycles, p_l2) + l1_fill_ps + extra
+
+        # ---- apply: directory entry + sharer-bitmap delta (winners
+        # hold distinct (home, dset, way) slots by the election above)
+        fidx_w = jnp.where(serve, fidx, jnp.int32(2**30))
+        state = state._replace(dir_word=state.dir_word.at[
+            way, fidx_w].set(
+            dword_pack(line, state.round_ctr, act.new_state,
+                       act.new_owner), mode="drop"))
+        # Reps land (new - old) per plane; combining members add their
+        # own bit on top of the rep's rewritten row (guarded against an
+        # already-set bit for resident S members; a cold member's bit
+        # can never be in the rep's fresh row) — ONE merged scatter-add,
+        # as in the round loop.
+        delta_sh = act.new_sharers - entry_row
+        plane = jnp.arange(W, dtype=jnp.int32)[:, None] * A + way[None, :]
+        req_word = (rows // 64).astype(jnp.int32)
+        req_bit = jnp.uint64(1) << (rows % 64).astype(jnp.uint64)
+        row_f = jnp.take_along_axis(
+            dsharers, way[:, None, None], axis=1)[:, 0, :]
+        own_w = jnp.take_along_axis(row_f, req_word[:, None],
+                                    axis=1)[:, 0]
+        member_add = member & (~hit
+                               | ((own_w & req_bit) == jnp.uint64(0)))
+        add_rows = jnp.concatenate(
+            [plane.reshape(-1), req_word * A + way])
+        add_cols = jnp.concatenate(
+            [jnp.broadcast_to(fidx_w[None, :], (W, T)).reshape(-1),
+             jnp.where(member_add, fidx, jnp.int32(2**30))])
+        add_vals = jnp.concatenate([delta_sh.T.reshape(-1), req_bit])
+        state = state._replace(dir_sharers=state.dir_sharers.at[
+            add_rows, add_cols].add(add_vals, mode="drop"))
+
+        # ---- owner-side downgrade deliveries: per-target [T, J_OWN]
+        # line lists (ranks < J_OWN are unique per target by the budget
+        # election above), one invalidate/downgrade sweep per cache.
+        ow_put = serve & owner_leg
+        ow_tgt = jnp.where(ow_put, owner, T).astype(jnp.int32)
+        ow_slot = jnp.minimum(posr, J_OWN - 1)
+        own_lines = jnp.zeros((T, J_OWN), dtype=jnp.int64).at[
+            ow_tgt, ow_slot].set(line, mode="drop")
+        own_valid = jnp.zeros((T, J_OWN), dtype=bool).at[
+            ow_tgt, ow_slot].set(True, mode="drop")
+        own_down = jnp.zeros((T, J_OWN), dtype=jnp.int32).at[
+            ow_tgt, ow_slot].set(act.owner_downgrade_to, mode="drop")
+        state = state._replace(
+            l2=cachemod.invalidate_by_value(
+                state.l2, own_lines, own_valid, own_down),
+            l1d=cachemod.invalidate_by_value(
+                state.l1d, own_lines, own_valid, own_down))
+
+        # ---- requester-side fills at serve time (the round loop's
+        # winner path) + victim notify / DRAM writeback occupancy
+        granted_e = serve & ~is_ex & (act.new_state == E)
+        if shared_l2:
+            l1_state = jnp.where(is_ex, M,
+                                 jnp.where(granted_e, E, S)).astype(
+                                     jnp.int32)
+            fd = cachemod.fill(state.l1d, line, l1_state, serve_all & ~is_if,
+                               params.l1d.num_sets, params.l1d.replacement,
+                               rstamp)
+            fi = cachemod.fill(state.l1i, line,
+                               jnp.full(T, S, dtype=jnp.int32),
+                               serve_all & is_if, params.l1i.num_sets,
+                               params.l1i.replacement, rstamp)
+            state = state._replace(l1d=fd.cache, l1i=fi.cache)
+            vs1 = jnp.where(serve_all & ~is_if, fd.victim_state, I)
+            vlive1 = serve_all & (vs1 != I)
+            victim_dirty = vlive1 & (vs1 == M)
+            state = _sh_l1_evict_notify(params, state, rows,
+                                        fd.victim_tag, vs1, vlive1)
+            state = _sh_l1_evict_notify(
+                params, state, rows, fi.victim_tag, fi.victim_state,
+                serve_all & is_if & (fi.victim_state != I))
+        else:
+            f2 = cachemod.fill(state.l2, line,
+                               jnp.where(is_ex, M, S).astype(jnp.int32),
+                               serve_all, params.l2.num_sets,
+                               params.l2.replacement, rstamp)
+            state = state._replace(l2=f2.cache)
+            vt1, vs1 = f2.victim_tag, f2.victim_state
+            # Inclusion: the L2 victim's L1D copy drops with it.
+            state = state._replace(l1d=cachemod.invalidate_by_value(
+                state.l1d, vt1[:, None],
+                (serve_all & (vs1 != I))[:, None],
+                jnp.full((T, 1), I, dtype=jnp.int32)))
+            fd = cachemod.fill(state.l1d, line,
+                               jnp.where(is_ex, M, S).astype(jnp.int32),
+                               serve_all & ~is_if, params.l1d.num_sets,
+                               params.l1d.replacement, rstamp)
+            fi = cachemod.fill(state.l1i, line,
+                               jnp.full(T, S, dtype=jnp.int32),
+                               serve_all & is_if, params.l1i.num_sets,
+                               params.l1i.replacement, rstamp)
+            state = state._replace(l1d=fd.cache, l1i=fi.cache)
+            victim_dirty = serve_all & ((vs1 == M) | (vs1 == O))
+            victim_live = serve_all & (vs1 != I)
+            victim_home = dram_site_of_line(params, vt1)
+            if params.dram.queue_model_enabled:
+                r3 = queue_models.occupy(
+                    params.dram.queue_model_type,
+                    state.dram_ring_start, state.dram_ring_end,
+                    state.dram_ring_ptr, state.dram_qacc,
+                    victim_home, t_dir, dram_service_ps, victim_dirty,
+                    ma_window=params.dram.basic_ma_window)
+                state = state._replace(dram_ring_start=r3[0],
+                                       dram_ring_end=r3[1],
+                                       dram_ring_ptr=r3[2],
+                                       dram_qacc=r3[3])
+            state = _dir_evict_notify(params, state, rows, vt1, vs1,
+                                      victim_live)
+
+        # ---- miss-type classification (same rules as the round loop)
+        if params.track_miss_types:
+            HF = state.seen_filter.shape[1]
+            fslot = (dense.fmix64(line) % jnp.uint64(HF)).astype(jnp.int32)
+            key32 = (line + 1).astype(jnp.int32)
+            seen_hit = jnp.take_along_axis(
+                state.seen_filter, fslot[:, None], axis=1)[:, 0] == key32
+            inv_hit = jnp.take_along_axis(
+                state.inv_filter, fslot[:, None], axis=1)[:, 0] == key32
+            m_shar = serve_all & inv_hit
+            c2 = state.counters
+            state = state._replace(counters=c2._replace(
+                l2_miss_cold=c2.l2_miss_cold
+                + (serve_all & ~inv_hit & ~seen_hit).astype(jnp.int64),
+                l2_miss_capacity=c2.l2_miss_capacity
+                + (serve_all & ~inv_hit & seen_hit).astype(jnp.int64),
+                l2_miss_sharing=c2.l2_miss_sharing
+                + m_shar.astype(jnp.int64)))
+            rows_w = jnp.where(serve_all, rows, T).astype(jnp.int32)
+            state = state._replace(
+                seen_filter=state.seen_filter.at[rows_w, fslot].set(
+                    key32, mode="drop"),
+                inv_filter=state.inv_filter.at[
+                    jnp.where(m_shar, rows, T), fslot].set(
+                    0, mode="drop"))
+            # Record coherence take-aways (the round loop's inv_dlv
+            # rule): owner-downgrade deliveries that drop the target's
+            # copy to I mark the TARGET tile's filter for the delivered
+            # line, so its re-miss classifies as sharing, not
+            # cold/capacity.
+            inv_dlv = own_valid & (own_down == I)
+            dlv_line = own_lines
+            dslot = (dense.fmix64(dlv_line)
+                     % jnp.uint64(HF)).astype(jnp.int32)
+            tgt_rows = jnp.where(
+                inv_dlv, jnp.arange(T, dtype=jnp.int32)[:, None], T)
+            state = state._replace(
+                inv_filter=state.inv_filter.at[tgt_rows, dslot].set(
+                    (dlv_line + 1).astype(jnp.int32), mode="drop"))
+
+        # ---- counters (home-binned tallies via one stacked scatter)
+        b = lambda m: m.astype(jnp.int64)
+        home_cols = [
+            b(serve_all & ~is_ex), b(serve & is_ex),  # dir_sh/ex_req
+            b(evicting),                          # dir_evictions
+            b(owner_leg),                         # dir_writebacks
+            b(owner_leg & ~act.dram_write),       # dir_forwards
+            b(serve_all),                         # net_mem_pkts @home
+            jnp.where(serve_all, flits_data, 0),  # net_mem_flits @home
+        ]
+        if shared_l2:
+            home_cols += [b(serve_all), b(serve_all & ~hit)]  # l2_access/miss
+            dstack = jnp.stack([b(need_read), b(dram_wb)], axis=1)
+            db = jnp.zeros((T, 2), dtype=jnp.int64).at[dsite].add(dstack)
+            vic_wr = 0
+        else:
+            home_cols += [b(need_read), b(dram_wb)]
+            vic_wr = jnp.zeros(T, dtype=jnp.int64).at[
+                jnp.where(victim_dirty, victim_home, T)].add(
+                1, mode="drop")
+        hstack = jnp.stack(home_cols, axis=1)
+        hb = jnp.zeros((T, hstack.shape[1]), dtype=jnp.int64).at[
+            home].add(hstack)
+        if not shared_l2:
+            db = hb[:, 7:9]
+        c = state.counters
+        c = c._replace(
+            dir_sh_req=c.dir_sh_req + hb[:, 0],
+            dir_ex_req=c.dir_ex_req + hb[:, 1],
+            dir_evictions=c.dir_evictions + hb[:, 2],
+            dir_writebacks=c.dir_writebacks + hb[:, 3],
+            dir_forwards=c.dir_forwards + hb[:, 4],
+            dram_reads=c.dram_reads + db[:, 0],
+            dram_writes=c.dram_writes + db[:, 1] + vic_wr,
+            l2_access=c.l2_access + (hb[:, 7] if shared_l2 else 0),
+            l2_miss=c.l2_miss + (hb[:, 8] if shared_l2 else 0),
+            net_mem_pkts=c.net_mem_pkts + b(serve_all) + b(victim_dirty)
+            + hb[:, 5],
+            net_mem_flits=c.net_mem_flits + b(serve_all) * flits_req
+            + b(victim_dirty) * flits_data + hb[:, 6],
+            mem_stall_ps=c.mem_stall_ps + jnp.where(
+                serve_all, completion - issue, 0),
+        )
+        state = state._replace(counters=c)
+
+        # ---- serialization floor for later same-line requests (the
+        # round loop inherits this table) + chain bookkeeping.  Several
+        # rows can share one table slot this iteration (a rep with its
+        # combining members, or a hash collision between two served
+        # lines), so ONE writer per slot is elected by max availability
+        # (tile id breaking ties) — the round loop's dense path takes
+        # the same group max; an unmasked duplicate set would be
+        # backend-unspecified.
+        tkey = t_data * T + rows
+        tmax_t = jnp.full((H,), -1, jnp.int64).at[
+            jnp.where(serve_all, hidx, H)].max(tkey, mode="drop")
+        fwin = serve_all & (tmax_t[hidx] == tkey)
+        ftbl = dense.stacked_set_table(hidx, fwin,
+                                       jnp.stack([line, t_data]), ftbl)
+        base = jnp.where(serve_all, completion, base)
+        head = head + serve_all.astype(jnp.int32)
+        return state, stopped, head, base, ftbl
+
+    base0 = jnp.where(head0 == 0, 0, state.chain_base)
+    carry = (state, jnp.zeros(T, dtype=bool), head0, base0, ftbl)
+    state, _, head, base, ftbl = jax.lax.fori_loop(0, P, slot_body, carry)
+    # Drained chains restore the absolute clock (last completion + the
+    # local time the window accumulated past the final bank); partial
+    # chains keep their continuation base for the round loop.
+    drained = (state.mq_count > 0) & (head >= state.mq_count)
     state = state._replace(
-        mq_head=jnp.where(drained, 0,
-                          jnp.maximum(first_slow, head)),
+        mq_head=jnp.where(drained, 0, head),
         mq_count=jnp.where(drained, 0, state.mq_count),
-        chain_base=jnp.where(drained, 0, new_base),
-        clock=jnp.where(drained, new_base + state.chain_rel, state.clock),
+        chain_base=jnp.where(drained, 0, base),
+        clock=jnp.where(drained, base + state.chain_rel, state.clock),
         chain_rel=jnp.where(drained, 0, state.chain_rel),
         round_ctr=state.round_ctr + 1,
     )
-    return state
+    return state, ftbl
 
 
 def resolve_memory(params: SimParams, state: SimState) -> SimState:
@@ -671,13 +764,22 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                                params.net_memory.flit_width_bits)
     dense_tables = T * H <= _DENSE_MAX_ELEMS
     slots_p = jnp.arange(max(P, 1), dtype=jnp.int32)[:, None]
+    contended = (params.net_memory.model == "emesh_hop_by_hop"
+                 and params.net_memory.queue_model_enabled)
 
-    # Vectorized fast pass first: serves every non-conflicting chain
-    # element in one shot; the round loop below handles the leftovers
-    # (conflicting lines, owner legs, invalidation fan-outs, iocoom).
+    # Blocking-semantics chain fast pass first: replays whole banked
+    # chains sequentially inside ONE engine round (fori over chain
+    # slots), serving every element up to its chain's first cross-tile
+    # line conflict / traffic-needing transition; the round loop below
+    # serves the leftovers one element per round with the full FCFS
+    # machinery.  The serialization-floor table is threaded through so
+    # leftovers observe fast-served lines' availability times.
+    ftbl0 = jnp.stack([jnp.full((H,), -1, dtype=jnp.int64),
+                       jnp.zeros((H,), dtype=jnp.int64)])
     if P > 0 and params.core.model == "simple" \
-            and (P * T) * (P * T) <= (1 << 26):
-        state = chain_fast_pass(params, state)
+            and params.directory.directory_type == "full_map" \
+            and not contended:
+        state, ftbl0 = chain_fast_pass(params, state, H, ftbl0)
 
     def _parked(st):
         k = st.pend_kind
@@ -707,7 +809,6 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 return jnp.sum(jnp.where(head_oh, arr, 0), axis=0)
 
             req = hsel(state.mq_req)
-            cvic = hsel(state.mq_victim)
             cdelta = hsel(state.mq_delta)
             # Element 0's delta is its absolute issue time; later elements
             # chain off the previous element's continuation point.
@@ -720,7 +821,6 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             unres = has_chain
         else:
             has_chain = jnp.zeros(T, dtype=bool)
-            cvic = jnp.zeros(T, dtype=jnp.int64)
             kind = state.pend_kind
             line = state.pend_addr >> line_bits
             issue = state.pend_issue
@@ -1358,48 +1458,37 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
                 inv_filter=state.inv_filter.at[tgt_rows, dslot].set(
                     (dlv_line_i + 1).astype(jnp.int32), mode="drop"))
 
-        # ---- requester-side fills / victims.  P > 0: every winner is a
-        # chain element that installed its line at BANK time — only its
-        # recorded victim is processed here (directory notify + DRAM
-        # writeback occupancy).  P == 0: parked winners fill now, as in
-        # the round-3 engine.
-        win_chain = win if P > 0 else jnp.zeros_like(win)
-        win_park = jnp.zeros_like(win) if P > 0 else win
+        # ---- requester-side fills / victims: EVERY winner — a P == 0
+        # parked request or a P > 0 chain head — installs its line at
+        # SERVE time (blocking semantics: nothing was installed at bank
+        # time), choosing its victim against the post-serve cache state;
+        # the fill's victim feeds the directory notify + DRAM writeback
+        # occupancy below.
         granted_e = win & ~is_ex & (act.new_state == E)
-        if P > 0:
-            vt1 = cvic >> 3
-            vs1 = (cvic & 7).astype(jnp.int32)
-            if params.protocol_kind == "sh_l2_mesi":
-                # A chain winner banked its read as S; an E grant raises
-                # the already-installed copy in place.
-                state = state._replace(l1d=cachemod.raise_line_state(
-                    state.l1d, rows.astype(jnp.int32), line,
-                    win_chain & granted_e & ~is_if, E,
-                    params.l1d.num_sets))
-        elif params.shared_l2:
+        if params.shared_l2:
             # MESI first-reader grant: fill the L1 line in E so a later
             # local store silently upgrades it (core.py mesi_local path).
             l1_state = jnp.where(is_ex, M,
                                  jnp.where(granted_e, E, S)).astype(
                                      jnp.int32)
             fd = cachemod.fill(state.l1d, line, l1_state,
-                               win_park & ~is_if,
+                               win & ~is_if,
                                params.l1d.num_sets, params.l1d.replacement,
                                rstamp)
             state = state._replace(l1d=fd.cache)
             fi = cachemod.fill(state.l1i, line,
                                jnp.full(T, S, dtype=jnp.int32),
-                               win_park & is_if, params.l1i.num_sets,
+                               win & is_if, params.l1i.num_sets,
                                params.l1i.replacement, rstamp)
             state = state._replace(l1i=fi.cache)
             # i-fetch L1I victims notify separately below via vt_i.
             vt1 = fd.victim_tag
-            vs1 = jnp.where(win_park & ~is_if, fd.victim_state, I)
+            vs1 = jnp.where(win & ~is_if, fd.victim_state, I)
             vt_i, vs_i = fi.victim_tag, fi.victim_state
         else:
             f2 = cachemod.fill(state.l2, line,
                                jnp.where(is_ex, M, S).astype(jnp.int32),
-                               win_park, params.l2.num_sets,
+                               win, params.l2.num_sets,
                                params.l2.replacement, rstamp)
             state = state._replace(l2=f2.cache)
             vt1, vs1 = f2.victim_tag, f2.victim_state
@@ -1407,16 +1496,16 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             # reference l2_cache_cntlr invalidation of L1 on eviction).
             state = state._replace(l1d=cachemod.invalidate_by_value(
                 state.l1d, f2.victim_tag[:, None],
-                (win_park & (f2.victim_state != I))[:, None],
+                (win & (f2.victim_state != I))[:, None],
                 jnp.full((T, 1), I, dtype=jnp.int32)))
             fd = cachemod.fill(state.l1d, line,
                                jnp.where(is_ex, M, S).astype(jnp.int32),
-                               win_park & ~is_if, params.l1d.num_sets,
+                               win & ~is_if, params.l1d.num_sets,
                                params.l1d.replacement, rstamp)
             state = state._replace(l1d=fd.cache)
             fi = cachemod.fill(state.l1i, line,
                                jnp.full(T, S, dtype=jnp.int32),
-                               win_park & is_if, params.l1i.num_sets,
+                               win & is_if, params.l1i.num_sets,
                                params.l1i.replacement, rstamp)
             state = state._replace(l1i=fi.cache)
 
@@ -1431,10 +1520,9 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             victim_dirty = vlive1 & (vs1 == M)
             state = _sh_l1_evict_notify(params, state, rows, vt1, vs1,
                                         vlive1)
-            if P == 0:
-                state = _sh_l1_evict_notify(
-                    params, state, rows, vt_i, vs_i,
-                    win_park & is_if & (vs_i != I))
+            state = _sh_l1_evict_notify(
+                params, state, rows, vt_i, vs_i,
+                win & is_if & (vs_i != I))
         else:
             victim_dirty = win & ((vs1 == M) | (vs1 == O))
             victim_live = win & (vs1 != I)
@@ -1453,7 +1541,6 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             # eviction writebacks that downgrade the entry; silently
             # dropping them left stale owners/sharer bits that charge
             # phantom coherence legs).  Off the requester's critical path.
-            # (Chain victims' L1 copies already dropped at bank time.)
             state = _dir_evict_notify(params, state, rows, vt1, vs1,
                                       victim_live)
 
@@ -1616,25 +1703,25 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # Parked winners unblock (cursor advance + stall accounting;
         # P > 0 has no memory parks — the complex slot banks instead).
         if P == 0:
-            state = _unblock(state, win_park, unpark, sync=False)
+            state = _unblock(state, win, unpark, sync=False)
         # Chain winners advance their chain: the continuation point
         # becomes the base for the next element's issue; a fully drained
         # chain restores the absolute clock (base + accumulated local
         # time) and frees the bank for the next window.
         if P > 0:
             c4 = state.counters
-            new_head = state.mq_head + win_chain.astype(jnp.int32)
-            drained = win_chain & (new_head >= state.mq_count)
+            new_head = state.mq_head + win.astype(jnp.int32)
+            drained = win & (new_head >= state.mq_count)
             state = state._replace(
                 mq_head=jnp.where(drained, 0, new_head),
                 mq_count=jnp.where(drained, 0, state.mq_count),
-                chain_base=jnp.where(win_chain, unpark, state.chain_base),
+                chain_base=jnp.where(win, unpark, state.chain_base),
                 clock=jnp.where(drained, unpark + state.chain_rel,
                                 state.clock),
                 chain_rel=jnp.where(drained, 0, state.chain_rel),
                 counters=c4._replace(
                     mem_stall_ps=c4.mem_stall_ps
-                    + jnp.where(win_chain, unpark - issue, 0)))
+                    + jnp.where(win, unpark - issue, 0)))
 
         # ---- serialization floor for still-pending same-line requests:
         # per-line winner's data-availability time, into the carried
@@ -1649,8 +1736,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             ftbl = jnp.where(wrote[None, :],
                              jnp.stack([new_line, new_t]), ftbl)
         else:
-            # Both fields land in ONE stacked scatter (winners are
-            # unique per slot, so the masked set cannot collide).
+            # Both fields land in ONE stacked scatter.  Elected winners
+            # are unique per slot; COMBINED SH winners of one line do
+            # collide here with per-member availability times (the
+            # dense path above takes the group max) — a pre-existing
+            # backend-ordering wart left as-is because this path is part
+            # of the miss_chain = 0 bit-identity surface (it engages
+            # only above the dense-table cap, T > 512, where combined
+            # same-line floors differ by sub-cycle NoC skew).
             ftbl = dense.stacked_set_table(
                 hidx, win, jnp.stack([line, t_free]), ftbl)
         state = state._replace(round_ctr=state.round_ctr + 1,
@@ -1673,8 +1766,6 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         return (i < cap) & _more(st)
 
     state = state._replace(ctr_resolve=state.ctr_resolve + 1)
-    ftbl0 = jnp.stack([jnp.full((H,), -1, dtype=jnp.int64),
-                       jnp.zeros((H,), dtype=jnp.int64)])
     carry = (jnp.int32(0), state, ftbl0)
     _, state, _ = jax.lax.while_loop(round_cond, round_body, carry)
     # Saturation visibility (VERDICT weak #5): requests still pending after

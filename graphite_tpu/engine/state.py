@@ -288,14 +288,16 @@ class SimState(NamedTuple):
     #   1024 tiles).  See dir_sharers_view for the unpacked view.
 
     # -- banked miss chains (tpu/miss_chain > 0; engine/core.py window).
-    # The block window executes past L2 misses: the line is installed
-    # optimistically at bank time and the request is banked here; resolve
-    # prices whole chains FCFS (element k+1's issue = element k's
-    # completion + its recorded local delta).  Packed fields:
+    # BLOCKING semantics (round 7): the block window executes past L2
+    # misses on a relative clock, banking each request here WITHOUT
+    # installing the line — the resolve pass replays the chain
+    # sequentially (engine/resolve.chain_fast_pass), pricing element k+1
+    # against the post-element-k directory state and installing each
+    # line at serve time; stall-on-use hazards in the window keep later
+    # events from observing a banked fill early.  Element k+1's issue =
+    # element k's completion + its recorded local delta.  Packed fields:
     #   mq_req    int64: kind (PEND_SH/EX/IFETCH) bits 0-2 | atomic bit 3
     #             | line << 8
-    #   mq_victim int64: local-install victim state bits 0-2 | tag << 3
-    #             (private: the L2 victim; shared-L2: the L1 victim)
     #   mq_delta  int64 ps: element 0 — ABSOLUTE issue time; element k>0 —
     #             issue relative to element k-1's continuation point
     #   mq_extra  int64 ps: local cost folded into the completion
@@ -303,7 +305,6 @@ class SimState(NamedTuple):
     # element's (not yet known) continuation point; chain_base is the
     # continuation time of the last SERVED element (mq_head of them).
     mq_req: jnp.ndarray        # [P, T] int64
-    mq_victim: jnp.ndarray     # [P, T] int64
     mq_delta: jnp.ndarray      # [P, T] int64
     mq_extra: jnp.ndarray      # [P, T] int64
     mq_count: jnp.ndarray      # [T] int32 banked elements
@@ -625,7 +626,6 @@ def make_state(params: SimParams,
         dir_sharers=jnp.zeros((W * d_shape[0], d_shape[1]),
                               dtype=jnp.uint64),
         mq_req=jnp.zeros((params.miss_chain, T), dtype=jnp.int64),
-        mq_victim=jnp.zeros((params.miss_chain, T), dtype=jnp.int64),
         mq_delta=jnp.zeros((params.miss_chain, T), dtype=jnp.int64),
         mq_extra=jnp.zeros((params.miss_chain, T), dtype=jnp.int64),
         mq_count=jnp.zeros(T, dtype=jnp.int32),

@@ -50,8 +50,8 @@ _TILE_AXIS_BY_FIELD = {
     "dir_word": 1,                   # [A, T*dsets] (tile-major flat)
     "dir_sharers": 1,                # [W*A, T*dsets]
     "ch_time": 1,                    # [D, T, T]
-    "mq_req": 1, "mq_victim": 1,     # [P, T] banked miss chains
-    "mq_delta": 1, "mq_extra": 1,
+    "mq_req": 1,                     # [P, T] banked miss chains
+    "mq_delta": 1, "mq_extra": 1,    # (blocking chain replay, round 7)
     "lq_ready": 1, "sq_ready": 1,    # [entries, T]
     "dram_ring_start": 1, "dram_ring_end": 1,   # [R, T]
     "link_free_mem": 1,              # [NUM_DIRS, T]

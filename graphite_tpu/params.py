@@ -69,6 +69,23 @@ def _block_events(value: int) -> int:
     return value
 
 
+# Upper bound on tpu/miss_chain: the chain replay is a fori_loop of P
+# per-slot phases inside ONE resolve pass, so P is a direct multiplier
+# on per-pass device work — past the low hundreds the pass stops being
+# "a round" in any honest sense, and the [P, T] bank arrays start to
+# rival the caches.  Banking depth beyond a window's miss yield per
+# sub-round (~block_events) buys nothing anyway: the chain cadence
+# serves every sub-round.
+MISS_CHAIN_MAX = 256
+
+
+def _miss_chain(value: int) -> int:
+    if not 0 <= value <= MISS_CHAIN_MAX:
+        raise ConfigError(
+            f"tpu/miss_chain must be in [0, {MISS_CHAIN_MAX}]: {value}")
+    return value
+
+
 def _syscall_costs(cfg: Config) -> tuple:
     """[syscall] per-class service cycles, ordered by isa.SyscallClass."""
     from graphite_tpu.isa import SyscallClass
@@ -677,15 +694,20 @@ class SimParams:
     # per-round invalidation scatter at [budget, T] instead of [T, T].
     max_inv_fanout_per_round: int
     # Miss-chain banking depth (the round-4 perf design): the block window
-    # keeps executing past L2 misses, installing the line optimistically
-    # and banking up to this many pending requests per tile; one resolve
-    # pass then prices each tile's whole chain (element k+1's issue is
-    # element k's completion plus the recorded local delta), so a tile
-    # costs ~1 device round per CHAIN instead of one per miss.  0 restores
-    # the round-3 one-parked-request engine (the equivalence oracle).
+    # keeps executing past L2 misses WITHOUT installing them (blocking
+    # semantics, stall-on-use), banking up to this many pending requests
+    # per tile; each resolve pass replays banked chains sequentially
+    # inside one engine round (element k+1 is priced against the
+    # post-element-k directory state; its issue is element k's
+    # completion plus the recorded local delta), so a tile costs ~1
+    # device round per CHAIN instead of one per miss.  Gated at 2%
+    # completion parity against the oracle (tests/
+    # test_chain_equivalence.py).  0 restores the round-3
+    # one-parked-request engine (the equivalence oracle) bit-exactly.
     miss_chain: int
-    # Upper bound on conflict rounds per resolve pass (chains + same-line
-    # serialization); leftovers carry to the next pass via mq_head.
+    # Upper bound on one-element-per-round conflict rounds per resolve
+    # pass (the fan-out/live-victim fallback after the chain replay);
+    # leftovers carry to the next sub-round's pass via mq_head.
     max_resolve_rounds: int
     channel_depth: int
     # Captured-trace replay: a recorded COND_WAIT provably consumed SOME
@@ -948,10 +970,9 @@ class SimParams:
             max_inv_fanout_per_round=_positive(cfg.get_int(
                 "tpu/max_inv_fanout_per_round", 8),
                 "tpu/max_inv_fanout_per_round"),
-            miss_chain=_nonneg(cfg.get_int("tpu/miss_chain", 0),
-                               "tpu/miss_chain"),
+            miss_chain=_miss_chain(cfg.get_int("tpu/miss_chain", 0)),
             max_resolve_rounds=_positive(
-                cfg.get_int("tpu/max_resolve_rounds", 64),
+                cfg.get_int("tpu/max_resolve_rounds", 4),
                 "tpu/max_resolve_rounds"),
             channel_depth=cfg.get_int("tpu/channel_depth", 16),
             cond_replay=cfg.get_bool("tpu/cond_replay", False),

@@ -92,6 +92,38 @@ def _run_sim(trace, num_tiles, **over):
     return sim, summary
 
 
+def test_chain_off_bit_identical_to_golden():
+    """miss_chain = 0 round-identity oracle for the chain rebuild
+    (ISSUE 6): the blocking-chain machinery is compiled in ONLY when
+    tpu/miss_chain > 0, so the default engine must stay BIT-IDENTICAL —
+    per-tile clocks, every counter, and every phase-execution counter —
+    to the pre-rebuild engine, pinned here as a committed fixture
+    (tests/data/chain_off_golden.json, captured from the round-6 HEAD;
+    the engine is deterministic, so any drift is a real semantic
+    change, not noise)."""
+    import json
+    import os
+    gold = json.load(open(os.path.join(
+        os.path.dirname(__file__), "data", "chain_off_golden.json")))
+    cases = {
+        "radix8": synth.gen_radix(num_tiles=8, keys_per_tile=64,
+                                  radix=16, seed=3),
+        "fft8": synth.gen_fft(num_tiles=8, points_per_tile=64),
+    }
+    for name, trace in cases.items():
+        g = gold[name]
+        sim, s = _run_sim(trace, 8, **{"tpu/miss_chain": 0})
+        assert s.done.all()
+        assert s.completion_time_ps == g["completion_time_ps"], name
+        assert np.asarray(s.clock).tolist() == g["clock"], name
+        for f, want in g["round_ctrs"].items():
+            got = int(getattr(sim.state, f))
+            assert got == want, f"{name}.{f}: {got} != golden {want}"
+        for k, want in g["counters"].items():
+            assert np.asarray(s.counters[k]).tolist() == want, \
+                f"{name}.{k}"
+
+
 @pytest.mark.parametrize("num_tiles", [
     8,
     pytest.param(64, marks=pytest.mark.slow),   # T=64 pays 2 big compiles

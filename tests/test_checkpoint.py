@@ -33,6 +33,46 @@ def test_resume_bit_identical(tmp_path):
         assert np.array_equal(a, s_res.counters[f]), f
 
 
+def test_resume_chained_run_identical(tmp_path):
+    """Checkpoint/resume THROUGH the miss-chain machinery (schema v22):
+    a chained radix run split mid-flight — banked mq_* elements, chain
+    base/rel clocks and all — must retire the same engine rounds and
+    final clocks as the unbroken run.  (The chain arrays are live state
+    between the bank and the serve; a resume that dropped or reordered
+    them would re-price or lose banked requests.)"""
+    import jax
+
+    cfg = load_config()
+    cfg.set("general/total_cores", 8)
+    cfg.set("tpu/miss_chain", 12)
+    params = SimParams.from_config(cfg)
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=48, radix=16, seed=7)
+
+    full = Simulator(params, trace)
+    s_full = full.run(max_steps=96)
+    assert s_full.done.all()
+
+    half = Simulator(params, trace)
+    half.run(max_steps=2)
+    ck = str(tmp_path / "ck_chain.npz")
+    half.save_checkpoint(ck)
+
+    resumed = Simulator(params, trace)
+    resumed.restore_checkpoint(ck)
+    s_res = resumed.run(max_steps=96)
+    assert s_res.done.all()
+
+    assert s_full.completion_time_ps == s_res.completion_time_ps
+    np.testing.assert_array_equal(s_full.clock, s_res.clock)
+    for f in ("ctr_quantum", "ctr_window", "ctr_complex", "ctr_conflict",
+              "ctr_resolve", "round_ctr"):
+        a = int(jax.device_get(getattr(full.state, f)))
+        b = int(jax.device_get(getattr(resumed.state, f)))
+        assert a == b, f"{f}: unbroken {a} != resumed {b}"
+    for f, a in s_full.counters.items():
+        assert np.array_equal(a, s_res.counters[f]), f
+
+
 def test_checkpoint_shape_guard(tmp_path):
     cfg = load_config()
     cfg.set("general/total_cores", 8)

@@ -112,3 +112,40 @@ def test_oversubscribed_barrier_across_all_streams():
         tb.done(s)
     s = _run(tb.build(), 4, threads_per_core=2)
     assert s.done.all()
+
+
+def test_rotated_parked_wake_skew_bounded():
+    """Wake-skew bound for rotated-out parked streams: a stream parked
+    on a mutex while descheduled wakes within one rotation period of
+    the release it waits for (its park is re-checked when the seat
+    rotates back — round-robin guarantees that within
+    general/switch_quantum of simulated time).  The completion of a
+    fully serialized lock convoy is therefore bounded by one rotation
+    period + one lax quantum of slack per handoff; a wake path that
+    strands rotated-out parkers past their rotation blows this bound
+    (or deadlocks) long before it breaks honest scheduler timing."""
+    from graphite_tpu.events.schema import TraceBuilder
+    streams, tiles, acq, hold = 4, 2, 6, 50
+    tb = TraceBuilder(streams)
+    for s in range(streams):
+        for _ in range(acq):
+            tb.mutex_lock(s, 0)
+            tb.compute(s, hold, hold)
+            tb.mutex_unlock(s, 0)
+        tb.done(s)
+    summary = _run(tb.build(), tiles, threads_per_core=2)
+    assert summary.done.all(), "lock convoy did not drain"
+    p = summary.params
+    handoffs = streams * acq
+    # Per-handoff work before any scheduler skew: the critical section
+    # (50 cycles) + mutex acquire/release MCP round trips — well under
+    # 100 ns at default clocks; the bound is dominated by the rotation
+    # period, which is the quantity under test.
+    per_handoff_ps = 100_000
+    bound = handoffs * (per_handoff_ps + p.thread_switch_quantum_ps
+                        + p.quantum_ps)
+    assert summary.completion_time_ps <= bound, (
+        f"completion {summary.completion_time_ps} ps exceeds the "
+        f"{handoffs}-handoff skew bound {bound} ps "
+        f"(rotation {p.thread_switch_quantum_ps} ps + quantum "
+        f"{p.quantum_ps} ps each)")

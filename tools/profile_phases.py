@@ -18,7 +18,7 @@ import time
 
 import jax
 
-from graphite_tpu.config import load_config
+from graphite_tpu.config import (apply_set_overrides, load_config, split_set_overrides)
 from graphite_tpu.engine import quantum
 from graphite_tpu.engine.core import local_advance
 from graphite_tpu.engine.resolve import resolve
@@ -38,23 +38,12 @@ def bench_fn(fn, *args, iters=8):
 
 
 def main():
-    overrides = []
-    plain = []
-    it = iter(sys.argv[1:])
-    for a in it:
-        if a == "--set":
-            overrides.append(next(it))
-        elif a.startswith("--set="):
-            overrides.append(a[len("--set="):])
-        else:
-            plain.append(a)
+    plain, overrides = split_set_overrides(sys.argv[1:])
     tiles = [int(a) for a in plain] or [64, 256, 1024]
     for T in tiles:
         cfg = load_config()
         cfg.set("general/total_cores", T)
-        for ov in overrides:
-            key, _, val = ov.partition("=")
-            cfg.set(key, val)
+        apply_set_overrides(cfg, overrides)
         params = SimParams.from_config(cfg)
         trace = synth.gen_radix(num_tiles=T, keys_per_tile=2048, seed=1)
         ta = TraceArrays.from_trace(trace)

@@ -21,7 +21,7 @@ import jax
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
-from graphite_tpu.config import load_config
+from graphite_tpu.config import (apply_set_overrides, load_config, split_set_overrides)
 from graphite_tpu.engine import resolve as rs
 from graphite_tpu.engine.core import _block_retire, _complex_slot
 from graphite_tpu.engine.sim import Simulator
@@ -53,23 +53,12 @@ def fused(fn, state, ta, iters):
 
 
 def main():
-    overrides = []
-    args = []
-    it = iter(sys.argv[1:])
-    for a in it:
-        if a == "--set":
-            overrides.append(next(it))
-        elif a.startswith("--set="):
-            overrides.append(a[len("--set="):])
-        else:
-            args.append(a)
+    args, overrides = split_set_overrides(sys.argv[1:])
     T = int(args[0]) if len(args) > 0 else 64
     iters = int(args[1]) if len(args) > 1 else 50
     cfg = load_config()
     cfg.set("general/total_cores", T)
-    for ov in overrides:
-        key, _, val = ov.partition("=")
-        cfg.set(key, val)
+    apply_set_overrides(cfg, overrides)
     params = SimParams.from_config(cfg)
     trace = synth.gen_radix(num_tiles=T, keys_per_tile=256, seed=1)
     sim = Simulator(params, trace)

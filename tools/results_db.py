@@ -16,9 +16,12 @@ Usage:
 
 ``add`` also flags engine-throughput regressions: each ingested row's
 rounds/s (bench ``engine_rounds`` or RunReport ``quanta`` over
-``host_seconds``) is compared against the most recent prior run of the
-same workload, and a drop of more than 20% prints a ``REGRESSION`` line
-(exit code stays 0 — the flag is for CI greps and humans, not a gate).
+``host_seconds``) AND simulated MIPS are compared against the most
+recent prior run of the same workload, and a drop of more than 20% in
+either prints a ``REGRESSION`` line (exit code stays 0 — the flag is
+for CI greps and humans, not a gate).  Both metrics matter since the
+miss-chain engine trades rounds for heavier rounds: rounds/s alone
+would call that a regression, MIPS alone would hide a fixed-cost one.
 
 Importable: ``open_db``, ``add_run``, ``query``, ``check_regression``.
 """
@@ -66,32 +69,50 @@ def rounds_per_sec(row: dict):
     return float(rounds) / float(host_s)
 
 
+def _mips(row: dict):
+    """Simulated MIPS of an ingested row; None when absent (probe /
+    skipped rows) or non-positive."""
+    m = row.get("mips")
+    try:
+        m = float(m)
+    except (TypeError, ValueError):
+        return None
+    return m if m > 0 else None
+
+
 def check_regression(db: sqlite3.Connection, workload: str, row: dict,
                      threshold_pct: float = REGRESSION_PCT):
-    """Compare ``row``'s rounds/s against the most recent COMPARABLE
-    prior run of the same workload already in the DB (skipped_budget/
-    failed rows carry no throughput and are stepped over, so one bad
-    ingest can't mask later regressions); returns a warning string when
-    it regressed by more than ``threshold_pct``, else None.  Call BEFORE
-    add_run so the comparison point is genuinely prior."""
-    new = rounds_per_sec(row)
-    if new is None:
-        return None
-    old = None
-    for (raw,) in db.execute(
-            "SELECT raw_json FROM runs WHERE workload = ? "
-            "ORDER BY ts DESC, id DESC", (workload,)):
-        old = rounds_per_sec(json.loads(raw))
-        if old is not None:
-            break
-    if old is None or old <= 0:
-        return None
-    drop = (old - new) / old * 100.0
-    if drop > threshold_pct:
-        return (f"REGRESSION {workload}: {new:.1f} rounds/s vs prior "
+    """Compare ``row``'s rounds/s AND simulated MIPS against the most
+    recent COMPARABLE prior run of the same workload already in the DB
+    (skipped_budget/failed rows carry no throughput and are stepped
+    over, so one bad ingest can't mask later regressions); returns a
+    warning string when either regressed by more than
+    ``threshold_pct``, else None.  Each metric compares against the
+    most recent prior row that HAS that metric, so a probe row without
+    MIPS doesn't break the MIPS chain.  Call BEFORE add_run so the
+    comparison point is genuinely prior."""
+    metrics = (("rounds/s", rounds_per_sec), ("MIPS", _mips))
+    warnings = []
+    for name, fn in metrics:
+        new = fn(row)
+        if new is None:
+            continue
+        old = None
+        for (raw,) in db.execute(
+                "SELECT raw_json FROM runs WHERE workload = ? "
+                "ORDER BY ts DESC, id DESC", (workload,)):
+            old = fn(json.loads(raw))
+            if old is not None:
+                break
+        if old is None or old <= 0:
+            continue
+        drop = (old - new) / old * 100.0
+        if drop > threshold_pct:
+            warnings.append(
+                f"REGRESSION {workload}: {new:.1f} {name} vs prior "
                 f"{old:.1f} (-{drop:.0f}% > {threshold_pct:.0f}% "
                 f"threshold)")
-    return None
+    return "\n".join(warnings) if warnings else None
 
 
 def add_run(db: sqlite3.Connection, workload: str, row: dict,

@@ -11,14 +11,22 @@ cd "$(dirname "$0")/.."
 fail=0
 total_pass=0
 total_fail=0
+chain_out=""
+chain_rc=5
 for f in tests/test_*.py; do
-    out=$(timeout 1800 python -m pytest "$f" -q "$@" 2>&1)
+    out=$(timeout 1800 python -m pytest "$f" -q -rxX "$@" 2>&1)
     rc=$?
     line=$(echo "$out" | grep -E "^[0-9]+ (passed|failed)|passed|failed|error" | tail -1)
     echo "$f: $line"
     if [ $rc -ne 0 ] && [ $rc -ne 5 ]; then   # 5 = no tests collected (marker filter)
         fail=1
         echo "$out" | tail -30
+    fi
+    # The chain-oracle gate below inspects this module's outcome
+    # classes without re-running it.
+    if [ "$f" = "tests/test_chain_equivalence.py" ]; then
+        chain_out="$out"
+        chain_rc=$rc
     fi
 done
 # Telemetry smoke: run a tiny trace through the CLI with --telemetry-dir
@@ -117,6 +125,43 @@ fi
 if ! timeout 60 python bench.py --help > /dev/null 2>&1; then
     echo "bench.py --help FAILED"
     fail=1
+fi
+
+# Chain-oracle gate (ISSUE 6): the blocking-semantics miss-chain engine
+# must match the one-parked-request oracle within 2% — these equality
+# tests were xfail documentation of the round-4 MSHR machine's
+# behavioral gap and are now hard gates.  The module already ran once
+# in the loop above (-rxX reports outcome classes, honoring this
+# invocation's marker tier — T=8 shapes by default, T=64 under
+# -m slow); here its captured output is REFUSED on any xfail/xpass
+# outcome, so a future regression to non-blocking behavior (or a
+# re-xfail of the tests) cannot ship silently.
+if [ $chain_rc -eq 5 ]; then
+    # rc 5 = nothing collected (also the sentinel for "module never
+    # ran") — legitimate only under an explicit marker/keyword filter;
+    # say so loudly instead of passing silently.
+    echo "chain-oracle gate: SKIPPED (no chain tests collected in this" \
+         "tier — the default tier always collects them)"
+elif [ $chain_rc -ne 0 ]; then
+    echo "chain-oracle gate: $(echo "$chain_out" | grep -E "passed|failed|error" | tail -1)"
+    echo "CHAIN ORACLE GATE FAILED"
+    fail=1
+elif echo "$chain_out" | grep -qE "xfailed|xpassed"; then
+    echo "$chain_out" | tail -10
+    echo "CHAIN ORACLE GATE FAILED (xfail markers are not allowed here)"
+    fail=1
+else
+    line=$(echo "$chain_out" | grep -E "passed|failed|error" | tail -1)
+    echo "chain-oracle gate: $line"
+    # The quick tier holds 5 chain tests (2 equality gates + 3
+    # invariants); fewer passing means one was slow-marked/skipped out
+    # of the tier — deselection must be as loud as an xfail.
+    npass=$(echo "$line" | grep -oE "^[0-9]+" | head -1)
+    if [ "${npass:-0}" -lt 5 ]; then
+        echo "CHAIN ORACLE GATE FAILED (only ${npass:-0} chain tests ran" \
+             "in this tier; the 2 equality gates + 3 invariants must all run)"
+        fail=1
+    fi
 fi
 
 if [ $fail -eq 0 ]; then

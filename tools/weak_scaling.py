@@ -1,0 +1,201 @@
+"""Weak-scaling datapoint: the radix1024 bench row over the 8-device
+``jax.distributed`` dryrun mesh vs a single device (VERDICT #10 — the
+repo's first scale number).
+
+The bench's radix1024 row (1024 tiles, 16 keys/tile, radix 64,
+``tpu/block_events = 4``) is the largest completion-sized shape BASELINE
+scores.  This tool runs a bounded, warmed window of its quantum steps
+twice — once on one device, once tile-sharded (parallel/mesh.py) over
+an 8-device mesh, the dryrun mesh's device count — and reports quanta/s
+for each.  On CPU the collectives are loopback memcpy, so the number
+bounds coordination overhead from above rather than demonstrating ICI
+bandwidth; PROFILE.md round 7 records the measured pair.
+
+Mesh legs, tried in order:
+  * two coordinator-connected processes x 4 virtual devices — the
+    ``jax.distributed`` path tools/multihost_dryrun.py exercises.  On
+    this container's jax build, cross-process ``device_put`` of
+    replicated leaves fails with "Multiprocess computations aren't
+    implemented on the CPU backend" (the dryrun itself fails the same
+    way here), so
+  * fallback: ONE process with ``--xla_force_host_platform_device_count
+    =8`` — identical mesh axes, sharding specs, and per-device
+    partitions; only the process boundary (DCN leg) is gone.
+
+    python tools/weak_scaling.py                 # both runs + summary
+    python tools/weak_scaling.py --single        # one-device leg only
+    python tools/weak_scaling.py --mesh8-local   # fallback mesh leg
+    python tools/weak_scaling.py --rank N        # internal (mesh rank)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+PORT = 29821
+NPROC = 2
+LOCAL_DEVICES = 4
+NUM_TILES = 1024
+QUANTA = 24
+WARM_QUANTA = 8
+
+
+def _build(params_only=False):
+    from graphite_tpu.config import load_config
+    from graphite_tpu.params import SimParams
+
+    cfg = load_config()
+    cfg.set("general/total_cores", NUM_TILES)
+    cfg.set("tpu/block_events", 4)       # the bench radix1024 row config
+    cfg.set("tpu/quanta_per_step", 1)
+    return SimParams.from_config(cfg)
+
+
+def _measure(tag: str) -> dict:
+    """Run WARM_QUANTA + QUANTA quantum steps of the radix1024 shape on
+    whatever device set jax exposes; returns the timed leg's rates."""
+    import jax
+
+    from graphite_tpu.engine.quantum import megastep
+    from graphite_tpu.engine.state import TraceArrays, make_state
+    from graphite_tpu.events import synth
+    from graphite_tpu.parallel.mesh import make_mesh, shard_pytree
+
+    params = _build()
+    trace = synth.gen_radix(NUM_TILES, keys_per_tile=16, radix=64)
+    mesh = make_mesh(jax.devices())
+    state = shard_pytree(make_state(params, has_capi=False), mesh,
+                         NUM_TILES)
+    tarrays = shard_pytree(TraceArrays.from_trace(trace), mesh, NUM_TILES)
+    step = jax.jit(lambda s, t: megastep(params, s, t))
+    for _ in range(WARM_QUANTA):
+        state = step(state, tarrays)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(QUANTA):
+        state = step(state, tarrays)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    quanta = int(jax.device_get(state.ctr_quantum))
+    cursor = int(jax.device_get(state.cursor.sum()))
+    return {
+        "mode": tag,
+        "devices": len(jax.devices()),
+        "num_tiles": NUM_TILES,
+        "timed_quanta": QUANTA,
+        "seconds": round(dt, 3),
+        "quanta_per_s": round(QUANTA / dt, 3),
+        "total_quanta": quanta,
+        "cursor_sum": cursor,
+    }
+
+
+def run_single() -> dict:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    return _measure("single_device")
+
+
+def run_mesh8_local() -> dict:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    return _measure("mesh8_local")
+
+
+def run_rank(rank: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}").strip()
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(f"127.0.0.1:{PORT}", num_processes=NPROC,
+                               process_id=rank)
+    row = _measure(f"mesh8_rank{rank}")
+    print("WEAK_SCALING_ROW " + json.dumps(row), flush=True)
+    jax.distributed.shutdown()
+
+
+def orchestrate_mesh() -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
+                        "PYTHONSTARTUP")}
+    env["PYTHONPATH"] = repo
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--rank", str(r)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo)
+        for r in range(NPROC)
+    ]
+    row = None
+    ok = True
+    for r, p in enumerate(procs):
+        out, _ = p.communicate(timeout=3600)
+        ok &= p.returncode == 0
+        for line in out.splitlines():
+            if line.startswith("WEAK_SCALING_ROW ") and row is None:
+                row = json.loads(line[len("WEAK_SCALING_ROW "):])
+        if p.returncode != 0:
+            print(out[-2000:], file=sys.stderr)
+    if not ok or row is None:
+        raise RuntimeError("mesh leg failed")
+    return row
+
+
+def main() -> int:
+    if "--rank" in sys.argv:
+        run_rank(int(sys.argv[sys.argv.index("--rank") + 1]))
+        return 0
+    if "--single" in sys.argv:
+        print(json.dumps(run_single()))
+        return 0
+    if "--mesh8-local" in sys.argv:
+        print(json.dumps(run_mesh8_local()))
+        return 0
+    # Each leg in its own subprocess so it gets a clean jax runtime.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+
+    def leg(flag):
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), flag],
+            capture_output=True, text=True, env=env, cwd=repo,
+            timeout=3600)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"{flag} leg failed:\n"
+                + out.stdout[-1500:] + out.stderr[-1500:])
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    single = leg("--single")
+    try:
+        mesh = orchestrate_mesh()
+    except Exception as e:
+        print(f"jax.distributed mesh leg unavailable "
+              f"({str(e).splitlines()[-1][:120]}); using the "
+              f"single-process 8-device mesh", file=sys.stderr)
+        mesh = leg("--mesh8-local")
+    summary = {
+        "single_device": single,
+        "mesh8": mesh,
+        "mesh8_vs_single_quanta_per_s": round(
+            mesh["quanta_per_s"] / max(single["quanta_per_s"], 1e-9), 3),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
