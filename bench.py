@@ -178,6 +178,69 @@ def _run(trace_fn, num_tiles: int, max_steps=None, label=None, **overrides):
     return row
 
 
+def _sweep_row():
+    import time
+
+    import numpy as np
+
+    from graphite_tpu import obs
+    from graphite_tpu.config import load_config
+    from graphite_tpu.engine.sim import Simulator
+    from graphite_tpu.events import synth
+    from graphite_tpu.sweep import SweepDriver, build_variants
+
+    V = 8
+    T = 8
+    cfg = load_config()
+    cfg.set("general/total_cores", T)
+    trace = synth.gen_radix(T, keys_per_tile=256, radix=64, seed=7)
+    specs = ["dram/latency=" + ",".join(
+        str(60 + 20 * i) for i in range(V))]
+    variants = build_variants(cfg, specs)
+    assert len(variants) == V
+
+    with obs.span("radix8_sweep8.warmup"):
+        warm = SweepDriver(trace, max_steps=2)
+        for _, _, p in variants:
+            warm.submit(p)
+        warm.drain()
+
+    drv = SweepDriver(trace)
+    tickets = [drv.submit(p) for _, _, p in variants]
+    t0 = time.perf_counter()
+    with obs.span("radix8_sweep8.timed_run"):
+        results = drv.drain()
+    host_s = time.perf_counter() - t0
+    summaries = [results[t] for t in tickets]
+    all_done = all(bool(s.done.all()) for s in summaries)
+
+    # Bit-identity spot check: first + last lanes vs solo runs (checking
+    # all 8 would pay 8 serial compiles for no extra signal — the lanes
+    # run one program, so two endpoints witness the whole batch).
+    def matches(idx):
+        solo = Simulator(variants[idx][2], trace).run()
+        lane = summaries[idx]
+        if not np.array_equal(lane.clock, solo.clock):
+            return False
+        return all(np.array_equal(lane.counters[k], solo.counters[k])
+                   for k in lane.counters)
+
+    sweep_matches_serial = bool(matches(0) and matches(V - 1))
+    return {
+        "kind": "completed" if all_done else "throughput_probe",
+        "num_tiles": T,
+        "variants": V,
+        "host_seconds": round(host_s, 3),
+        "variants_per_sec": round(V / max(host_s, 1e-9), 3),
+        "sweep_matches_serial": sweep_matches_serial,
+        "compiles": drv.compiles_observed,
+        "all_done": all_done,
+        "completion_time_ns_by_variant": [
+            round(s.completion_time_ps / 1000.0, 1) for s in summaries],
+        "workload": "radix8 x 8 DRAM-latency variants (vmapped sweep)",
+    }
+
+
 # Captured SPLASH-2 workloads (reference: tests/benchmarks/Makefile:4-8):
 # UNMODIFIED vendored sources, macro-expanded (tools/splash_m4.py) +
 # TSan-instrumented (tools/capture_build.sh), run natively to produce a
@@ -381,6 +444,15 @@ def main(argv=None) -> int:
     # Miss-chain A/B: the headline trace with chains on (ISSUE 6) —
     # runs FIRST so the round-count evidence survives any later timeout.
     safe("radix64_chain12", chain_ab)
+
+    # Sweep-engine row (ISSUE 7): V=8 DRAM-latency variants of a radix8
+    # trace as ONE vmapped device program — the design-space-exploration
+    # amortization headline.  variants_per_sec is the sweep's throughput
+    # unit (completed config points per host second, compile excluded by
+    # the warm-up drain like every other row); sweep_matches_serial
+    # asserts the bit-identity contract on the batch's first and last
+    # lanes against solo Simulator runs (clocks + every counter).
+    safe("radix8_sweep8", _sweep_row)
 
     # BASELINE config 1 scaling: radix at 256 and 1024 tiles.  Every
     # point COMPLETES (valid MIPS) — the 1024 row runs a narrow block

@@ -6,6 +6,7 @@ file plus ``--section/key=value`` overrides and run a simulation.
 
 Usage:
     graphite-tpu run [-c CONFIG] [--section/key=value ...] --trace TRACE.npz
+    graphite-tpu sweep [-c CONFIG] --trace TRACE.npz --sweep key=v1,v2 ...
     graphite-tpu params [-c CONFIG] [--section/key=value ...]
 """
 
@@ -33,6 +34,23 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="enable run telemetry (host span tracing + "
                           "[telemetry] round metrics) and write "
                           "run_report.json + run_trace.json under DIR")
+
+    sw = sub.add_parser(
+        "sweep", help="run V config variants of one trace as a single "
+                      "vmapped device program")
+    sw.add_argument("-c", "--config", default=None)
+    sw.add_argument("--trace", required=True, help="trace .npz path")
+    sw.add_argument("--sweep", action="append", default=[], metavar="SPEC",
+                    required=True,
+                    help="sweep axis: section/key=v1,v2,... — repeat for "
+                         "a cross product; join keys with ';' inside one "
+                         "flag to zip them (sweep/space.py grammar). "
+                         "Keys must be VARIANT leaves (timing numerics); "
+                         "structural keys are rejected.")
+    sw.add_argument("-o", "--output", default=None,
+                    help="write per-variant JSON rows here (shaped like a "
+                         "bench result: {'detail': {label: row}}, so "
+                         "tools/results_db.py add ingests it directly)")
 
     par = sub.add_parser("params", help="print derived simulation parameters")
     par.add_argument("-c", "--config", default=None)
@@ -70,7 +88,60 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # after this run's artifacts are written.
                 obs.enable_tracing(False)
 
+    if args.command == "sweep":
+        return _sweep_command(cfg, args)
+
     return 2
+
+
+def _sweep_command(cfg, args) -> int:
+    import time
+
+    from graphite_tpu.events.schema import Trace
+    from graphite_tpu.sweep import SweepDriver, build_variants
+    from graphite_tpu.time_base import ps_to_ns
+
+    trace = Trace.load(args.trace)
+    variants = build_variants(cfg, args.sweep, num_tiles=trace.num_tiles)
+    drv = SweepDriver(trace)
+    tickets = [(label, overrides, drv.submit(p))
+               for label, overrides, p in variants]
+    t0 = time.perf_counter()
+    results = drv.drain()
+    host_s = time.perf_counter() - t0
+    detail = {}
+    for label, overrides, ticket in tickets:
+        s = results[ticket]
+        d = s.to_dict()
+        detail[label] = {
+            "kind": "sweep_variant",
+            "overrides": overrides,
+            "num_tiles": d["num_tiles"],
+            "completion_time_ns": d["completion_time_ns"],
+            "total_instructions": d["total_instructions"],
+            "all_done": d["all_done"],
+            "quanta": d["quanta"],
+            "aggregate": d["aggregate"],
+        }
+        print(f"{label}: completion "
+              f"{ps_to_ns(s.completion_time_ps):.1f} ns, "
+              f"{'done' if d['all_done'] else 'INCOMPLETE'}, "
+              f"{d['total_instructions']} instrs")
+    out = {
+        "metric": "sweep",
+        "workload": args.trace,
+        "variants": len(tickets),
+        "host_seconds": round(host_s, 3),
+        "variants_per_sec": round(len(tickets) / max(host_s, 1e-9), 3),
+        "compiles": drv.compiles_observed,
+        "detail": detail,
+    }
+    line = json.dumps(out)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    return 0
 
 
 def _run_command(cfg, args, telemetry_dir: Optional[str]) -> int:

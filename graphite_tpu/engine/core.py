@@ -46,6 +46,7 @@ from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
     PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
     PEND_START, SimState, TraceArrays)
+from graphite_tpu.engine.vparams import VariantParams, variant_params
 from graphite_tpu.events.schema import ICACHE_BYTES_PER_INSTRUCTION
 from graphite_tpu.isa import DVFSModule, EventOp, SyscallClass
 from graphite_tpu import params as params_mod
@@ -138,7 +139,7 @@ def _window_refresh(params: SimParams, st: SimState, trace: TraceArrays,
     return st._replace(win_meta=wm, win_addr=wa, win_base=wb, win_seat=ws)
 
 
-def _block_retire(params: SimParams, st: SimState,
+def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
                   trace: TraceArrays) -> SimState:
     """Retire the leading run of simple events in each tile's [K] window.
 
@@ -209,9 +210,9 @@ def _block_retire(params: SimParams, st: SimState,
     p_l1i = _period(st, DVFSModule.L1_ICACHE)[:, None]
     p_l1d = _period(st, DVFSModule.L1_DCACHE)[:, None]
     p_l2 = _period(st, DVFSModule.L2_CACHE)[:, None]
-    l1i_ps = _lat(params.l1i.access_cycles, p_l1i)
-    l1d_ps = _lat(params.l1d.access_cycles, p_l1d)
-    l2_ps = _lat(params.l2.access_cycles, p_l2)
+    l1i_ps = _lat(vp.l1i_access_cycles, p_l1i)
+    l1d_ps = _lat(vp.l1d_access_cycles, p_l1d)
+    l2_ps = _lat(vp.l2_access_cycles, p_l2)
     cycle_ps = _lat(1, p_core)
 
     line = addr >> line_bits
@@ -512,7 +513,7 @@ def _block_retire(params: SimParams, st: SimState,
     dt_comp = cost_ps + fetch_ps \
         + jnp.where(comp_l2, n_lines * l2_ps, 0)
     dt_br = jnp.where(correct, cycle_ps,
-                      _lat(params.core.bp_mispredict_penalty, p_core)) \
+                      _lat(vp.bp_mispredict_penalty, p_core)) \
         + l1i_ps
     dt_mem = jnp.where(mem_l2, l1d_ps + l2_ps, l1d_ps)
     dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
@@ -536,12 +537,12 @@ def _block_retire(params: SimParams, st: SimState,
     # events then stop the prefix until the chain drains.  Boundary check:
     # absolute clock against the quantum boundary, or rel against one
     # quantum of post-miss overrun.
-    qps = jnp.int64(params.quantum_ps)
+    qps = vp.quantum_ps
     # Request-issue offset (local tag checks before the request leaves —
     # complex-slot `issue` math): L1 access + L2 tag check (L1-only under
     # shared L2).
     miss_tags_ps = cycle_ps if shared_l2 else \
-        _lat(params.l2.tags_access_cycles, p_l2)
+        _lat(vp.l2_tags_access_cycles, p_l2)
     issue_off = jnp.where(is_comp, l1i_ps, l1d_ps) + miss_tags_ps
     clk = st.clock
     rel = st.chain_rel if P > 0 else jnp.zeros(T, dtype=jnp.int64)
@@ -599,7 +600,7 @@ def _block_retire(params: SimParams, st: SimState,
     spawn_land = spawn_base + dt_spawn + noc.unicast_ps(
         params.net_user, jnp.broadcast_to(rows[:, None], (T, K)),
         child % T, 8, _period(st, DVFSModule.NETWORK_USER)[:, None],
-        params.mesh_width)
+        params.mesh_width, vnet=vp.net_user)
     spawned_at = st.spawned_at.at[
         jnp.where(is_spawn & retired, child, S_ids)].max(
         spawn_land, mode="drop")
@@ -781,7 +782,7 @@ def _block_retire(params: SimParams, st: SimState,
 
 # ======================================================== complex slot
 
-def _complex_slot(params: SimParams, state: SimState,
+def _complex_slot(params: SimParams, vp: VariantParams, state: SimState,
                   trace: TraceArrays) -> SimState:
     """One event per tile, every event kind — the general path."""
     T = params.num_tiles
@@ -871,10 +872,10 @@ def _complex_slot(params: SimParams, state: SimState,
     p_l2 = _period(st, DVFSModule.L2_CACHE)
     p_nu = _period(st, DVFSModule.NETWORK_USER)
 
-    l1i_ps = _lat(params.l1i.access_cycles, p_l1i)
-    l1d_ps = _lat(params.l1d.access_cycles, p_l1d)
-    l2_ps = _lat(params.l2.access_cycles, p_l2)
-    l2_tag_ps = _lat(params.l2.tags_access_cycles, p_l2)
+    l1i_ps = _lat(vp.l1i_access_cycles, p_l1i)
+    l1d_ps = _lat(vp.l1d_access_cycles, p_l1d)
+    l2_ps = _lat(vp.l2_access_cycles, p_l2)
+    l2_tag_ps = _lat(vp.l2_tags_access_cycles, p_l2)
     cycle_ps = _lat(1, p_core)
 
     shared_l2 = params.shared_l2
@@ -929,7 +930,7 @@ def _complex_slot(params: SimParams, state: SimState,
         correct = pred == taken
         dt_br = jnp.where(
             correct, cycle_ps,
-            _lat(params.core.bp_mispredict_penalty, p_core)) + l1i_ps
+            _lat(vp.bp_mispredict_penalty, p_core)) + l1i_ps
         bp_table = st.bp_table.at[
             rows, jnp.where(is_br & en, bidx, params.core.bp_size)
         ].set(taken, mode="drop")
@@ -984,8 +985,9 @@ def _complex_slot(params: SimParams, state: SimState,
                 params.net_user, params.mesh_width, params.mesh_height,
                 rows.astype(jnp.int32), dst, depart,
                 noc.num_flits(jnp.maximum(arg, 0),
-                              params.net_user.flit_width_bits),
-                is_send & active, st.link_free_user, p_nu)
+                              vp.net_user.flit_width_bits),
+                is_send & active, st.link_free_user, p_nu,
+                vnet=vp.net_user)
             st = st._replace(link_free_user=fl.link_free)
             c = c._replace(net_link_wait_ps=c.net_link_wait_ps
                            + jnp.where(is_send & active & en,
@@ -994,7 +996,7 @@ def _complex_slot(params: SimParams, state: SimState,
         else:
             send_net_ps = noc.unicast_ps(
                 params.net_user, rows, dst, jnp.maximum(arg, 0), p_nu,
-                params.mesh_width)
+                params.mesh_width, vnet=vp.net_user)
             arrival = depart + send_net_ps
         rows_send = jnp.where(is_send, rows, T).astype(jnp.int32)
         ch_time = st.ch_time.at[slot_idx, rows_send, dst].set(
@@ -1012,7 +1014,7 @@ def _complex_slot(params: SimParams, state: SimState,
     is_unlock = op == EventOp.MUTEX_UNLOCK
     to_mcp_ps = noc.unicast_ps(
         params.net_user, rows, jnp.full((T,), mcp), 8, p_nu,
-        params.mesh_width)
+        params.mesh_width, vnet=vp.net_user)
     NEG = jnp.int64(-(2**62))
     # barrier arrival bookkeeping (server side of SimBarrier)
     bar_id = jnp.clip(arg, 0, num_bars - 1)
@@ -1053,7 +1055,7 @@ def _complex_slot(params: SimParams, state: SimState,
     child = jnp.clip(arg2, 0, S_ids - 1)
     spawn_land = clk + _lat(jnp.maximum(arg, 0), p_core) \
         + noc.unicast_ps(params.net_user, rows, child % T, 8, p_nu,
-                         params.mesh_width)
+                         params.mesh_width, vnet=vp.net_user)
     spawned_at = st.spawned_at.at[
         jnp.where(is_spawn, child, S_ids)].max(spawn_land, mode="drop")
 
@@ -1069,7 +1071,7 @@ def _complex_slot(params: SimParams, state: SimState,
     # tile there is nothing to rotate to and the event is cost-only.
     is_yield = op == EventOp.YIELD
     dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
-    dt_dvfs = _lat(params.dvfs_sync_delay_cycles, p_core)
+    dt_dvfs = _lat(vp.dvfs_sync_delay_cycles, p_core)
 
     # SYSCALL: marshalled args ride the user network to the MCP's syscall
     # server, service takes the per-class cost, the result rides back
@@ -1077,12 +1079,12 @@ def _complex_slot(params: SimParams, state: SimState,
     # syscall_server.cc:43-130).  Closed-form — no cross-tile dependency,
     # so no park.  Futexes never reach here (they surface as sync events).
     is_sysc = op == EventOp.SYSCALL
-    svc_tbl = jnp.asarray(params.syscall_cost_cycles, dtype=jnp.int32)
+    svc_tbl = vp.syscall_cost_cycles
     svc_ps = _lat(svc_tbl[jnp.clip(arg, 0, len(params.syscall_cost_cycles)
                                    - 1)], p_core)
     sys_req_ps = noc.unicast_ps(
         params.net_user, rows, jnp.full((T,), mcp),
-        jnp.maximum(arg2, 0), p_nu, params.mesh_width)
+        jnp.maximum(arg2, 0), p_nu, params.mesh_width, vnet=vp.net_user)
     dt_sysc = sys_req_ps + svc_ps + to_mcp_ps + cycle_ps
     nmod = state.period_ps.shape[1]
     mod_oh = is_dvfs[:, None] & dense.onehot(
@@ -1260,7 +1262,7 @@ def _complex_slot(params: SimParams, state: SimState,
         net_user_flits=c.net_user_flits + jnp.where(
             is_send & en,
             noc.num_flits(jnp.maximum(arg, 0),
-                          params.net_user.flit_width_bits), 0),
+                          vp.net_user.flit_width_bits), 0),
         sends=add(c.sends, is_send),
         barriers=add(c.barriers, is_bar),
         cond_waits=add(c.cond_waits, is_cwait),
@@ -1343,7 +1345,8 @@ def _complex_slot(params: SimParams, state: SimState,
     return st
 
 
-def _complex_slot_guarded(params: SimParams, state: SimState,
+def _complex_slot_guarded(params: SimParams, vp: VariantParams,
+                          state: SimState,
                           trace: TraceArrays) -> SimState:
     """Run the general slot only when some tile can use it (P > 0): a
     mid-chain tile waits for resolve, so on miss-dominated stretches the
@@ -1352,7 +1355,7 @@ def _complex_slot_guarded(params: SimParams, state: SimState,
     slot's own active mask, so skipping is result-identical; at P == 0
     the slot runs unconditionally (bit-identity with the seed engine)."""
     if params.miss_chain <= 0:
-        return _complex_slot(params, state, trace)
+        return _complex_slot(params, vp, state, trace)
     N = trace.num_events
     eligible = (~state.done) & (state.pend_kind == PEND_NONE) \
         & (state.clock < state.boundary) & (state.cursor < N) \
@@ -1381,11 +1384,12 @@ def _complex_slot_guarded(params: SimParams, state: SimState,
         eligible = eligible & (~window_class | ~state.models_enabled)
     return jax.lax.cond(
         eligible.any(),
-        lambda s: _complex_slot(params, s, trace), lambda s: s, state)
+        lambda s: _complex_slot(params, vp, s, trace), lambda s: s, state)
 
 
 def local_advance(params: SimParams, state: SimState,
-                  trace: TraceArrays) -> SimState:
+                  trace: TraceArrays,
+                  vp: VariantParams = None) -> SimState:
     """Advance every non-blocked tile through events until the quantum
     boundary, stream end, or its first remote-blocking event.  Each loop
     round is a block retirement (a [T, K] window of simple events +
@@ -1408,7 +1412,13 @@ def local_advance(params: SimParams, state: SimState,
     (the round-7 profile: that wait was most of the window-round
     count), and the run-ahead staleness window shrinks to one
     sub-round.  The sub-round loop in quantum_step supplies the
-    iteration that the local loop supplies at P == 0."""
+    iteration that the local loop supplies at P == 0.
+
+    ``vp`` threads the VARIANT timing operands (engine/vparams.py);
+    omitted, it derives from ``params`` and traces as constants —
+    callers outside the sweep engine need not change."""
+    if vp is None:
+        vp = variant_params(params)
     if params.miss_chain > 0:
         if params.block_events > 0:
             # Enough window rounds per sub-round to fill the chain bank
@@ -1422,7 +1432,7 @@ def local_advance(params: SimParams, state: SimState,
             K = params.block_events
             cap_w = max(1, -(-params.miss_chain * 3 // (2 * K)))
             N = trace.num_events
-            qps = jnp.int64(params.quantum_ps)
+            qps = vp.quantum_ps
 
             def wprog(st):
                 return jnp.sum(st.cursor.astype(jnp.int64))
@@ -1433,7 +1443,7 @@ def local_advance(params: SimParams, state: SimState,
 
             def wbody(c):
                 j, _pv, cv, s = c
-                s = _block_retire(params, s, trace)
+                s = _block_retire(params, vp, s, trace)
                 return j + 1, cv, wprog(s), s
 
             def wloop(st):
@@ -1451,7 +1461,7 @@ def local_advance(params: SimParams, state: SimState,
                             state.clock < state.boundary)
             state = jax.lax.cond(can_retire.any(), wloop,
                                  lambda s: s, state)
-        return _complex_slot_guarded(params, state, trace)
+        return _complex_slot_guarded(params, vp, state, trace)
 
     def progress(st):
         return jnp.sum(st.cursor.astype(jnp.int64))
@@ -1476,12 +1486,12 @@ def local_advance(params: SimParams, state: SimState,
 
             def wbody(c):
                 j, _pv, cv, s = c
-                s = _block_retire(params, s, trace)
+                s = _block_retire(params, vp, s, trace)
                 return j + 1, cv, progress(s), s
 
             _, _, _, st = jax.lax.while_loop(
                 wcond, wbody, (jnp.int32(0), jnp.int64(-1), cur, st))
-        st = _complex_slot(params, st, trace)
+        st = _complex_slot(params, vp, st, trace)
         return i + 1, cur, progress(st), st
 
     _, _, _, state = jax.lax.while_loop(

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from graphite_tpu.engine.vparams import NetVariant, net_variant
 from graphite_tpu.params import NetworkParams
 
 # NetPacket header bytes modeled on the wire (reference: common/network/
@@ -48,27 +49,35 @@ def hop_count(src, dst, mesh_width: int):
 
 
 def unicast_ps(net: NetworkParams, src, dst, payload_bytes,
-               period_ps, mesh_width: int):
+               period_ps, mesh_width: int, vnet: NetVariant = None):
     """Zero-load packet latency in ps.
 
     ``period_ps``: int32 [K] — ps per cycle of the sender's network DVFS
     domain (latencies scale with DVFS, reference:
     network_model.h DVFS recompute).
+
+    ``vnet`` carries the network's numeric delays as traced operands
+    (sweep engine); omitted, they derive from ``net`` and trace as
+    constants — the pre-sweep program, bit-identically.
     """
     if net.model == "magic":
         return jnp.zeros(jnp.shape(src), dtype=jnp.int64)
+    if vnet is None:
+        vnet = net_variant(net)
     if net.model == "atac":
         from graphite_tpu.engine import noc_atac
-        return noc_atac.unicast_ps(net, src, dst, payload_bytes, period_ps)
+        return noc_atac.unicast_ps(net, src, dst, payload_bytes, period_ps,
+                                   vnet=vnet)
     hops = hop_count(src, dst, mesh_width)
-    flits = num_flits(payload_bytes, net.flit_width_bits)
-    cycles = hops * (net.router_delay_cycles + net.link_delay_cycles) \
+    flits = num_flits(payload_bytes, vnet.flit_width_bits)
+    cycles = hops * (vnet.router_delay_cycles + vnet.link_delay_cycles) \
         + jnp.maximum(flits - 1, 0)
     return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)
 
 
 def max_hop_to_mask_ps(net: NetworkParams, src, tile_mask,
-                       payload_bytes, period_ps, mesh_width: int):
+                       payload_bytes, period_ps, mesh_width: int,
+                       vnet: NetVariant = None):
     """Latency of the farthest unicast from ``src`` ([K]) to any tile set in
     ``tile_mask`` ([K, T] bool) — the invalidation-round-trip bound the
     directory charges when it must reach all sharers (reference:
@@ -78,16 +87,18 @@ def max_hop_to_mask_ps(net: NetworkParams, src, tile_mask,
     """
     if net.model == "magic":
         return jnp.zeros(jnp.shape(src), dtype=jnp.int64)
+    if vnet is None:
+        vnet = net_variant(net)
     if net.model == "atac":
         from graphite_tpu.engine import noc_atac
         return noc_atac.max_to_mask_ps(net, src, tile_mask, payload_bytes,
-                                       period_ps)
+                                       period_ps, vnet=vnet)
     T = tile_mask.shape[-1]
     tiles = jnp.arange(T)
     hops = hop_count(src[:, None], tiles[None, :], mesh_width)  # [K, T]
     max_hops = jnp.max(jnp.where(tile_mask, hops, 0), axis=-1)
-    flits = num_flits(payload_bytes, net.flit_width_bits)
-    cycles = max_hops * (net.router_delay_cycles + net.link_delay_cycles) \
+    flits = num_flits(payload_bytes, vnet.flit_width_bits)
+    cycles = max_hops * (vnet.router_delay_cycles + vnet.link_delay_cycles) \
         + jnp.maximum(flits - 1, 0)
     cycles = jnp.where(tile_mask.any(axis=-1), cycles, 0)
     return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)

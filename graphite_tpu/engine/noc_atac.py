@@ -30,10 +30,10 @@ from functools import lru_cache
 import jax.numpy as jnp
 import numpy as np
 
+from graphite_tpu.engine.vparams import NetVariant, net_variant
 from graphite_tpu.params import AtacParams, NetworkParams
 
 
-@lru_cache(maxsize=8)
 def geometry(a: AtacParams):
     """Static per-tile tables: (cluster_of [T], ap_hops [T], hub_of [C]).
 
@@ -43,7 +43,18 @@ def geometry(a: AtacParams):
       network_model_atac.cc:641-657).
     hub_of: the hub tile of each cluster (getTileIDWithOpticalHub) —
       cluster center.
+
+    The numpy derivation is cached per AtacParams; the jnp conversion
+    happens PER CALL — a cached jnp array created inside one jit trace
+    is a tracer, and reusing it from a later trace is a leak (hit the
+    moment two distinct ATAC programs compile in one process, e.g. a
+    serial run beside a sweep batch).
     """
+    return tuple(jnp.asarray(x) for x in _geometry_np(a))
+
+
+@lru_cache(maxsize=8)
+def _geometry_np(a: AtacParams):
     T, W = a.num_tiles, a.enet_width
     t = np.arange(T)
     x, y = t % W, t // W
@@ -75,76 +86,84 @@ def geometry(a: AtacParams):
     hub_x = (c % a.numx_clusters) * a.cluster_width + a.cluster_width // 2
     hub_y = (c // a.numx_clusters) * a.cluster_height + a.cluster_height // 2
     hub_of = hub_y * W + hub_x
-    return (jnp.asarray(cluster_of, jnp.int32),
-            jnp.asarray(ap_hops, jnp.int32),
-            jnp.asarray(hub_of, jnp.int32))
+    return (np.asarray(cluster_of, np.int32),
+            np.asarray(ap_hops, np.int32),
+            np.asarray(hub_of, np.int32))
 
 
-def _enet_cycles(a: AtacParams, net: NetworkParams, src, dst):
+def _enet_cycles(a: AtacParams, vnet: NetVariant, src, dst):
     """XY hop cycles on the electrical mesh (routePacketOnENet)."""
     from graphite_tpu.engine import noc
     hops = noc.hop_count(src, dst, a.enet_width)
-    return hops * (net.router_delay_cycles + net.link_delay_cycles)
+    return hops * (vnet.router_delay_cycles + vnet.link_delay_cycles)
 
 
-def _onet_cycles(a: AtacParams, net: NetworkParams, src):
+def _onet_cycles(a: AtacParams, vnet: NetVariant, src):
     """Cycles from ``src`` to ANY remote cluster's receive net output —
     the optical path is distance-independent (that is ATAC's point):
     src -> nearest access point (ENet) -> hub port hop -> send hub router
     -> optical link -> receive hub router -> star/htree receive leg.
     """
     _, ap_hops, _ = geometry(a)
-    per_hop = net.router_delay_cycles + net.link_delay_cycles
-    recv = a.star_net_router_delay + net.link_delay_cycles \
-        if a.receive_net_type == "star" else net.link_delay_cycles
+    per_hop = vnet.router_delay_cycles + vnet.link_delay_cycles
+    recv = vnet.atac_star_delay + vnet.link_delay_cycles \
+        if a.receive_net_type == "star" else vnet.link_delay_cycles
     return (ap_hops[src] * per_hop          # ENet to the access point
             + per_hop                       # access-point port -> hub
-            + a.send_hub_router_delay
-            + a.optical_link_delay_cycles
-            + a.receive_hub_router_delay
+            + vnet.atac_send_hub_delay
+            + vnet.atac_optical_cycles
+            + vnet.atac_receive_hub_delay
             + recv)
 
 
-def unicast_cycles(net: NetworkParams, src, dst):
+def unicast_cycles(net: NetworkParams, src, dst, vnet: NetVariant = None):
     """Zero-load unicast cycles src -> dst under ATAC routing
     (computeGlobalRoute, network_model_atac.cc:798-820): same cluster ->
     ENet; cross-cluster -> ONet (cluster_based) or ENet when within the
     unicast distance threshold (distance_based)."""
     a = net.atac
+    if vnet is None:
+        vnet = net_variant(net)
     cluster_of, _, _ = geometry(a)
-    enet = _enet_cycles(a, net, src, dst)
-    onet = _onet_cycles(a, net, src)
+    enet = _enet_cycles(a, vnet, src, dst)
+    onet = _onet_cycles(a, vnet, src)
     same = cluster_of[src] == cluster_of[dst]
     if a.global_routing_strategy == "distance_based":
         from graphite_tpu.engine import noc
         hops = noc.hop_count(src, dst, a.enet_width)
-        use_enet = same | (hops <= a.unicast_distance_threshold)
+        use_enet = same | (hops <= vnet.atac_unicast_threshold)
     else:
         use_enet = same
     return jnp.where(use_enet, enet, onet)
 
 
-def unicast_ps(net: NetworkParams, src, dst, payload_bytes, period_ps):
+def unicast_ps(net: NetworkParams, src, dst, payload_bytes, period_ps,
+               vnet: NetVariant = None):
     from graphite_tpu.engine import noc
-    flits = noc.num_flits(payload_bytes, net.flit_width_bits)
-    cycles = unicast_cycles(net, src, dst) + jnp.maximum(flits - 1, 0)
+    if vnet is None:
+        vnet = net_variant(net)
+    flits = noc.num_flits(payload_bytes, vnet.flit_width_bits)
+    cycles = unicast_cycles(net, src, dst, vnet=vnet) \
+        + jnp.maximum(flits - 1, 0)
     return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)
 
 
 def max_to_mask_ps(net: NetworkParams, src, tile_mask, payload_bytes,
-                   period_ps):
+                   period_ps, vnet: NetVariant = None):
     """Farthest-unicast bound over a [K, T] destination mask (the
     directory's invalidation fan-out charge).  Each destination is priced
     by its own route (ENet or ONet) — the optical broadcast reaches every
     remote cluster at one latency, so the max is typically the ONet
     constant or the longest intra-cluster ENet leg."""
     from graphite_tpu.engine import noc
-    a = net.atac
+    if vnet is None:
+        vnet = net_variant(net)
     T = tile_mask.shape[-1]
     tiles = jnp.arange(T, dtype=jnp.int32)
-    cyc = unicast_cycles(net, src[:, None], tiles[None, :])    # [K, T]
+    cyc = unicast_cycles(net, src[:, None], tiles[None, :],
+                         vnet=vnet)                            # [K, T]
     max_cyc = jnp.max(jnp.where(tile_mask, cyc, 0), axis=-1)
-    flits = noc.num_flits(payload_bytes, net.flit_width_bits)
+    flits = noc.num_flits(payload_bytes, vnet.flit_width_bits)
     cycles = jnp.where(tile_mask.any(axis=-1),
                       max_cyc + jnp.maximum(flits - 1, 0), 0)
     return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)

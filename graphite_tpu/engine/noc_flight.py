@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from graphite_tpu.engine import queue_models
+from graphite_tpu.engine.vparams import NetVariant, net_variant
 from graphite_tpu.params import NetworkParams
 
 # Link direction codes (outgoing link of a tile).
@@ -70,17 +71,22 @@ class FlightResult(NamedTuple):
 def flight(net: NetworkParams, mesh_width: int, mesh_height: int,
            src: jnp.ndarray, dst: jnp.ndarray, depart: jnp.ndarray,
            flits, active: jnp.ndarray, link_free: jnp.ndarray,
-           period_ps: jnp.ndarray) -> FlightResult:
+           period_ps: jnp.ndarray, vnet: NetVariant = None) -> FlightResult:
     """Fly a batch of packets src->dst, contending on shared links.
 
     src/dst: [K] int32 tiles; depart: [K] int64 ps; flits: scalar or [K];
     active: [K] bool (inactive packets neither move nor occupy);
     period_ps: [K] int32 ps per network cycle (sender's DVFS domain, used
-    for the whole path as in the zero-load model).
+    for the whole path as in the zero-load model).  ``vnet`` supplies the
+    per-hop delays as traced operands (sweep engine); derived from
+    ``net`` as constants when omitted.
     """
+    if vnet is None:
+        vnet = net_variant(net)
     T = link_free.shape[1]
     K = src.shape[0]
-    hop_cyc = net.router_delay_cycles + net.link_delay_cycles
+    hop_cyc = jnp.asarray(vnet.router_delay_cycles
+                          + vnet.link_delay_cycles, jnp.int64)
     max_hops = (mesh_width - 1) + (mesh_height - 1)
     per = jnp.asarray(period_ps, jnp.int64)
     fl = jnp.broadcast_to(jnp.asarray(flits, jnp.int64), (K,))

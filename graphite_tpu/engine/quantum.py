@@ -36,11 +36,13 @@ from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
     PEND_JOIN, PEND_MUTEX, PEND_RECV, PEND_SEND, PEND_SH_REQ, PEND_START,
     SimState, TraceArrays, sampling_enabled, stats_ring_enabled)
+from graphite_tpu.engine.vparams import VariantParams, variant_params
 from graphite_tpu.params import SimParams
 from graphite_tpu.time_base import TIME_MAX
 
 
-def next_boundary(params: SimParams, state: SimState) -> jnp.ndarray:
+def next_boundary(params: SimParams, state: SimState,
+                  vp: VariantParams = None) -> jnp.ndarray:
     """Advance the barrier boundary past the slowest runnable tile,
     skipping empty quanta (reference barrierRelease's quantum skip,
     lax_barrier_sync_server.cc:118-160)."""
@@ -55,7 +57,7 @@ def next_boundary(params: SimParams, state: SimState) -> jnp.ndarray:
                     | (state.pend_kind == PEND_START))
     runnable = ~state.done & ~sync_blocked
     min_clock = jnp.min(jnp.where(runnable, state.clock, TIME_MAX))
-    q = jnp.int64(params.quantum_ps)
+    q = vp.quantum_ps if vp is not None else jnp.int64(params.quantum_ps)
     nb = (min_clock // q + 1) * q
     return jnp.where(runnable.any(), nb,
                      state.boundary + q).astype(jnp.int64)
@@ -161,7 +163,8 @@ def _maybe_sample(params: SimParams, state: SimState) -> SimState:
     return jax.lax.cond(do, take, lambda st: st, state)
 
 
-def schedule_rotate(params: SimParams, state: SimState) -> SimState:
+def schedule_rotate(params: SimParams, state: SimState,
+                    vp: VariantParams = None) -> SimState:
     """ThreadScheduler seat rotation (reference: thread_scheduler.h:30-56,
     round_robin_thread_scheduler.cc; yield path thread_scheduler.cc:615-660).
 
@@ -209,8 +212,9 @@ def schedule_rotate(params: SimParams, state: SimState) -> SimState:
                 | (k == PEND_IFETCH)) | (state.mq_count > 0)
     unspawned_gate = (k == PEND_START) \
         & (state.spawned_at[sst] < 0)
-    expired = (state.boundary - state.seat_since) \
-        >= jnp.int64(params.thread_switch_quantum_ps)
+    switch_q = vp.thread_switch_quantum_ps if vp is not None \
+        else jnp.int64(params.thread_switch_quantum_ps)
+    expired = (state.boundary - state.seat_since) >= switch_q
     give_up = (state.done | state.seat_yield | unspawned_gate
                | expired) & ~mem_park
 
@@ -279,7 +283,8 @@ def schedule_rotate(params: SimParams, state: SimState) -> SimState:
 
 
 def quantum_step(params: SimParams, state: SimState,
-                 trace: TraceArrays) -> SimState:
+                 trace: TraceArrays,
+                 vp: VariantParams = None) -> SimState:
     """One barrier quantum: all tiles advance to the new boundary.
 
     Sub-rounds of (local_advance ; resolve) repeat while they make
@@ -292,11 +297,17 @@ def quantum_step(params: SimParams, state: SimState,
     (prev, cur) as scalars, so the cond is pure scalar compares.  The
     old shape recomputed both full-[T] sums in cond AND body — four
     reduction sweeps per round where one suffices (PROFILE.md: the
-    round is fixed-op bound at small T)."""
-    state = state._replace(boundary=next_boundary(params, state),
+    round is fixed-op bound at small T).
+
+    ``vp`` threads the VARIANT timing operands (engine/vparams.py): the
+    sweep engine passes a batched pytree under ``vmap``; omitted, it
+    derives from ``params`` and traces as constants."""
+    if vp is None:
+        vp = variant_params(params)
+    state = state._replace(boundary=next_boundary(params, state, vp=vp),
                            ctr_quantum=state.ctr_quantum + 1)
     if state.sched_enabled:
-        state = schedule_rotate(params, state)
+        state = schedule_rotate(params, state, vp=vp)
 
     # Chain cadence (tpu/miss_chain > 0): local_advance is ONE window
     # round + a guarded general slot, so the sub-round loop here is what
@@ -323,8 +334,8 @@ def quantum_step(params: SimParams, state: SimState,
 
     def body(carry):
         i, _prev, cur, st = carry
-        st = local_advance(params, st, trace)
-        st = resolve(params, st)
+        st = local_advance(params, st, trace, vp=vp)
+        st = resolve(params, st, vp=vp)
         # cur (this round's entry progress) becomes the next compare
         # floor; one reduction pass per round, in the body where it
         # fuses with the round's epilogue.
@@ -343,11 +354,56 @@ def megastep(params: SimParams, state: SimState,
              trace: TraceArrays) -> SimState:
     """``quanta_per_step`` quantum steps fused into one device program —
     the unit the host driver launches (and the unit `bench.py` times)."""
+    vp = variant_params(params)
 
     def body(st, _):
-        return quantum_step(params, st, trace), None
+        return quantum_step(params, st, trace, vp=vp), None
 
     state, _ = jax.lax.scan(body, state, None, length=params.quanta_per_step)
+    return state
+
+
+def megarun_loop(params: SimParams, vp: VariantParams, state: SimState,
+                 trace: TraceArrays, max_quanta,
+                 masked: bool = True) -> SimState:
+    """The megarun while_loop body, vp-threaded and UNJITTED — shared by
+    the serial ``megarun`` wrapper below (vp traces as constants) and the
+    sweep engine's vmapped invocation (graphite_tpu/sweep/batch.py, vp a
+    [V]-batched operand pytree).
+
+    With ``masked`` the body commits a quantum_step's result only where
+    the run was not already complete: under ``vmap`` the loop runs to
+    the SLOWEST variant and the mask freezes finished lanes bit-exactly
+    — their clocks, counters, and quantum counts stay what a solo run
+    would have produced.  The serial wrapper passes ``masked=False``:
+    its scalar cond already gates the body on ~done, so the mask could
+    only ever select the new state — skipping it is result-identical
+    and avoids a whole-SimState select per quantum (pass-through state
+    copies are a measured per-round cost on TPU; see resolve()'s
+    gating note).
+    """
+    start = state.ctr_quantum
+    budget = jnp.asarray(max_quanta, jnp.int64)
+
+    # The all_done reduction is carried: computed once per quantum at the
+    # END of the body (where it fuses with the quantum's epilogue ops)
+    # instead of re-sweeping the done/strm_done arrays in the cond — the
+    # cond then reads two scalars.
+    def cond(carry):
+        st, done = carry
+        return (~done) & ((st.ctr_quantum - start) < budget)
+
+    def body(carry):
+        st, done = carry
+        new = quantum_step(params, st, trace, vp=vp)
+        if masked:
+            st = jax.tree_util.tree_map(
+                lambda o, n: jnp.where(done, o, n), st, new)
+        else:
+            st = new
+        return st, st.all_done()
+
+    state, _ = jax.lax.while_loop(cond, body, (state, state.all_done()))
     return state
 
 
@@ -367,21 +423,5 @@ def megarun(params: SimParams, state: SimState, trace: TraceArrays,
     window size shares one compiled program (the warm-up run must warm
     the real program).
     """
-    start = state.ctr_quantum
-    budget = jnp.asarray(max_quanta, jnp.int64)
-
-    # The all_done reduction is carried: computed once per quantum at the
-    # END of the body (where it fuses with the quantum's epilogue ops)
-    # instead of re-sweeping the done/strm_done arrays in the cond — the
-    # cond then reads two scalars.
-    def cond(carry):
-        st, done = carry
-        return (~done) & ((st.ctr_quantum - start) < budget)
-
-    def body(carry):
-        st, _done = carry
-        st = quantum_step(params, st, trace)
-        return st, st.all_done()
-
-    state, _ = jax.lax.while_loop(cond, body, (state, state.all_done()))
-    return state
+    return megarun_loop(params, variant_params(params), state, trace,
+                        max_quanta, masked=False)

@@ -37,6 +37,7 @@ from graphite_tpu.engine.state import (
     PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
     PEND_START, SimState, dword_owner, dword_pack, dword_stamp, dword_state,
     dword_tag, dword_with_meta)
+from graphite_tpu.engine.vparams import VariantParams, variant_params
 from graphite_tpu.isa import DVFSModule
 from graphite_tpu.params import SimParams
 
@@ -192,8 +193,8 @@ def _unblock(state: SimState, mask, completion, sync: bool) -> SimState:
 
 # ===================================================================== memory
 
-def chain_fast_pass(params: SimParams, state: SimState, H: int,
-                    ftbl: jnp.ndarray):
+def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
+                    H: int, ftbl: jnp.ndarray):
     """Serve whole banked miss chains in ONE resolve pass with BLOCKING
     semantics — the round-7 throughput core (PROFILE.md lever 1).
 
@@ -271,12 +272,11 @@ def chain_fast_pass(params: SimParams, state: SimState, H: int,
     p_l2 = _period(state, DVFSModule.L2_CACHE)
     p_l1d = _period(state, DVFSModule.L1_DCACHE)
     p_l1i = _period(state, DVFSModule.L1_ICACHE)
-    dram_access_ps = jnp.int64(params.dram.latency_ps)
-    dram_service_ps = jnp.int64(
-        params.dram.processing_ps_per_line(params.line_size))
-    flits_req = noc.num_flits(CTRL_BYTES, params.net_memory.flit_width_bits)
+    dram_access_ps = vp.dram_latency_ps
+    dram_service_ps = vp.dram_processing_ps
+    flits_req = noc.num_flits(CTRL_BYTES, vp.net_memory.flit_width_bits)
     flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
-                               params.net_memory.flit_width_bits)
+                               vp.net_memory.flit_width_bits)
     rstamp = state.round_ctr * STAMP_STRIDE + STAMP_STRIDE - 1
 
     def slot_body(p, carry):
@@ -409,11 +409,13 @@ def chain_fast_pass(params: SimParams, state: SimState, H: int,
         # a fast element (owner/inv/evict legs are all zero by the
         # serve conditions)
         net_req = noc.unicast_ps(params.net_memory, rows, home,
-                                 CTRL_BYTES, p_net, params.mesh_width)
+                                 CTRL_BYTES, p_net, params.mesh_width,
+                                 vnet=vp.net_memory)
         reply_ps = noc.unicast_ps(params.net_memory, home, rows,
                                   params.line_size + CTRL_BYTES,
-                                  p_net[home], params.mesh_width)
-        dir_ps = _lat(params.directory.access_cycles, p_dir[home])
+                                  p_net[home], params.mesh_width,
+                                  vnet=vp.net_memory)
+        dir_ps = _lat(vp.dir_access_cycles, p_dir[home])
         # No serialization-floor READ here: slot-axis same-line pairs are
         # serialized by the directory-state replay itself (the later
         # element pays the post-predecessor transition — owner flush /
@@ -430,16 +432,16 @@ def chain_fast_pass(params: SimParams, state: SimState, H: int,
         # private L2, or its L1D under shared L2).
         p_net_own = p_net[owner]
         if shared_l2:
-            l2_own_ps = _lat(params.l1d.access_cycles, p_l1d[owner])
+            l2_own_ps = _lat(vp.l1d_access_cycles, p_l1d[owner])
         else:
-            l2_own_ps = _lat(params.l2.access_cycles, p_l2[owner])
+            l2_own_ps = _lat(vp.l2_access_cycles, p_l2[owner])
         leg_ps = noc.unicast_ps(params.net_memory, home, owner,
                                 CTRL_BYTES, p_net[home],
-                                params.mesh_width) \
+                                params.mesh_width, vnet=vp.net_memory) \
             + l2_own_ps \
             + noc.unicast_ps(params.net_memory, owner, home,
                              params.line_size + CTRL_BYTES, p_net_own,
-                             params.mesh_width)
+                             params.mesh_width, vnet=vp.net_memory)
         owner_ps = jnp.where(owner_leg, leg_ps, 0)
         need_read = serve_all & act.dram_read
         if shared_l2:
@@ -447,11 +449,11 @@ def chain_fast_pass(params: SimParams, state: SimState, H: int,
             local_ctl = home == dsite
             to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
                 params.net_memory, home, dsite, CTRL_BYTES, p_net[home],
-                params.mesh_width))
+                params.mesh_width, vnet=vp.net_memory))
             from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
                 params.net_memory, dsite, home,
                 params.line_size + CTRL_BYTES, p_net[dsite],
-                params.mesh_width))
+                params.mesh_width, vnet=vp.net_memory))
         else:
             dsite = home
             to_dram_ps = from_dram_ps = jnp.int64(0)
@@ -477,13 +479,13 @@ def chain_fast_pass(params: SimParams, state: SimState, H: int,
                              jnp.where(need_read, dram_ready, 0))
         reply_done = t_data + reply_ps
         l1_fill_ps = jnp.where(
-            is_if, _lat(params.l1i.access_cycles, p_l1i),
-            _lat(params.l1d.access_cycles, p_l1d))
+            is_if, _lat(vp.l1i_access_cycles, p_l1i),
+            _lat(vp.l1d_access_cycles, p_l1d))
         if shared_l2:
             completion = reply_done + l1_fill_ps + extra
         else:
             completion = reply_done \
-                + _lat(params.l2.access_cycles, p_l2) + l1_fill_ps + extra
+                + _lat(vp.l2_access_cycles, p_l2) + l1_fill_ps + extra
 
         # ---- apply: directory entry + sharer-bitmap delta (winners
         # hold distinct (home, dset, way) slots by the election above)
@@ -716,7 +718,8 @@ def chain_fast_pass(params: SimParams, state: SimState, H: int,
     return state, ftbl
 
 
-def resolve_memory(params: SimParams, state: SimState) -> SimState:
+def resolve_memory(params: SimParams, vp: VariantParams,
+                   state: SimState) -> SimState:
     """Serve all parked L2-miss requests through the home directories.
 
     Work per conflict round is O(T) + O(budget x T): same-line FCFS
@@ -756,12 +759,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     p_core = _period(state, DVFSModule.CORE)
     cycle_ps = _lat(1, p_core)
 
-    dram_access_ps = jnp.int64(params.dram.latency_ps)
-    dram_service_ps = jnp.int64(
-        params.dram.processing_ps_per_line(params.line_size))
-    flits_req = noc.num_flits(CTRL_BYTES, params.net_memory.flit_width_bits)
+    dram_access_ps = vp.dram_latency_ps
+    dram_service_ps = vp.dram_processing_ps
+    flits_req = noc.num_flits(CTRL_BYTES, vp.net_memory.flit_width_bits)
     flits_data = noc.num_flits(params.line_size + CTRL_BYTES,
-                               params.net_memory.flit_width_bits)
+                               vp.net_memory.flit_width_bits)
     dense_tables = T * H <= _DENSE_MAX_ELEMS
     slots_p = jnp.arange(max(P, 1), dtype=jnp.int32)[:, None]
     contended = (params.net_memory.model == "emesh_hop_by_hop"
@@ -779,7 +781,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
     if P > 0 and params.core.model == "simple" \
             and params.directory.directory_type == "full_map" \
             and not contended:
-        state, ftbl0 = chain_fast_pass(params, state, H, ftbl0)
+        state, ftbl0 = chain_fast_pass(params, vp, state, H, ftbl0)
 
     def _parked(st):
         k = st.pend_kind
@@ -842,11 +844,12 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         p_net_home = p_net[home]
         p_dir_home = p_dir[home]
         net_req = noc.unicast_ps(params.net_memory, rows, home, CTRL_BYTES,
-                                 p_net, params.mesh_width)
+                                 p_net, params.mesh_width,
+                                 vnet=vp.net_memory)
         reply_ps = noc.unicast_ps(params.net_memory, home, rows,
                                   params.line_size + CTRL_BYTES, p_net_home,
-                                  params.mesh_width)
-        dir_ps = _lat(params.directory.access_cycles, p_dir_home)
+                                  params.mesh_width, vnet=vp.net_memory)
+        dir_ps = _lat(vp.dir_access_cycles, p_dir_home)
         # Per-line serialization floor from the carried (line, time) hash
         # table (a stored-line check makes collisions inert).
         ftbl_g = ftbl[:, hidx]                     # [2, T] one gather
@@ -997,8 +1000,7 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             if scheme == "limitless":
                 scheme_dir_ps = jnp.where(
                     hit & (n_sh >= k_hw),
-                    _lat(params.directory.limitless_trap_cycles,
-                         p_dir_home), 0)
+                    _lat(vp.limitless_trap_cycles, p_dir_home), 0)
             elif scheme == "limited_no_broadcast":
                 cand = others
                 overflow_add = ~is_ex & (n_sh >= k_hw) \
@@ -1172,10 +1174,10 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # Invalidation round-trip latencies, mapped back per requester.
         inv_ps_k = 2 * noc.max_hop_to_mask_ps(
             params.net_memory, home_sr, inv_bool, CTRL_BYTES,
-            pnh_sr, params.mesh_width) + cyc_sr
+            pnh_sr, params.mesh_width, vnet=vp.net_memory) + cyc_sr
         vic_ps_k = 2 * noc.max_hop_to_mask_ps(
             params.net_memory, home_sr, vic_bool, CTRL_BYTES,
-            pnh_sr, params.mesh_width) + cyc_sr
+            pnh_sr, params.mesh_width, vnet=vp.net_memory) + cyc_sr
         inv_ps = jnp.where(has_inv, jnp.sum(
             jnp.where(oh_sr, inv_ps_k[:, None], 0), axis=0), 0)
         evict_ps = jnp.where(evict_s, jnp.sum(
@@ -1186,10 +1188,10 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         # the line in its private L2 — or only in its L1D under shared L2
         # (there is no private L2 there).
         if params.shared_l2:
-            l2_vown_ps = _lat(params.l1d.access_cycles,
+            l2_vown_ps = _lat(vp.l1d_access_cycles,
                               _period(state, DVFSModule.L1_DCACHE)[vown_c])
         else:
-            l2_vown_ps = _lat(params.l2.access_cycles, p_l2[vown_c])
+            l2_vown_ps = _lat(vp.l2_access_cycles, p_l2[vown_c])
 
         # ---- latency assembly (SURVEY.md 3.3's round trips).  Unicast
         # legs are either zero-load closed forms (magic/emesh_hop_counter)
@@ -1209,7 +1211,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         if contended:
             fr = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,
-                rows32, home, issue, flits_req, win, lf, p_net)
+                rows32, home, issue, flits_req, win, lf, p_net,
+                vnet=vp.net_memory)
             lf = fr.link_free
             link_wait = link_wait + fr.wait_ps
             arrive = jnp.maximum(fr.arrival, line_floor)
@@ -1226,23 +1229,24 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             dep_ev = arrive + dir_ps
             e1 = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,
-                home, vown_c, dep_ev, flits_req, ev_rt, lf, p_net_home)
+                home, vown_c, dep_ev, flits_req, ev_rt, lf, p_net_home,
+                vnet=vp.net_memory)
             e2 = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,
                 vown_c, home, e1.arrival + l2_vown_ps, flits_data, ev_rt,
-                e1.link_free, p_net_vown)
+                e1.link_free, p_net_vown, vnet=vp.net_memory)
             lf = e2.link_free
             link_wait = link_wait + e1.wait_ps + e2.wait_ps
             evict_m_ps = jnp.where(ev_rt, e2.arrival - dep_ev, 0)
         else:
             evict_m_ps = noc.unicast_ps(
                 params.net_memory, home, vown_c, CTRL_BYTES,
-                p_net_home, params.mesh_width) \
+                p_net_home, params.mesh_width, vnet=vp.net_memory) \
                 + l2_vown_ps \
                 + noc.unicast_ps(
                     params.net_memory, vown_c, home,
                     params.line_size + CTRL_BYTES,
-                    p_net_vown, params.mesh_width)
+                    p_net_vown, params.mesh_width, vnet=vp.net_memory)
         evict_ps = jnp.where(evict_m, evict_m_ps, evict_ps)
         if params.protocol_kind == "mosi":
             # O-state victim: sharer-invalidation multicast AND the
@@ -1258,29 +1262,30 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
 
         p_net_own = p_net[owner]
         if params.shared_l2:
-            l2_own_ps = _lat(params.l1d.access_cycles,
+            l2_own_ps = _lat(vp.l1d_access_cycles,
                              _period(state, DVFSModule.L1_DCACHE)[owner])
         else:
-            l2_own_ps = _lat(params.l2.access_cycles, p_l2[owner])
+            l2_own_ps = _lat(vp.l2_access_cycles, p_l2[owner])
         if contended:
             g1 = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,
-                home, owner, t_dir, flits_req, owner_leg, lf, p_net_home)
+                home, owner, t_dir, flits_req, owner_leg, lf, p_net_home,
+                vnet=vp.net_memory)
             g2 = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,
                 owner, home, g1.arrival + l2_own_ps, flits_data, owner_leg,
-                g1.link_free, p_net_own)
+                g1.link_free, p_net_own, vnet=vp.net_memory)
             lf = g2.link_free
             link_wait = link_wait + g1.wait_ps + g2.wait_ps
             owner_ps = jnp.where(owner_leg, g2.arrival - t_dir, 0)
         else:
             leg_ps = noc.unicast_ps(params.net_memory, home, owner,
                                     CTRL_BYTES, p_net_home,
-                                    params.mesh_width) \
+                                    params.mesh_width, vnet=vp.net_memory) \
                 + l2_own_ps \
                 + noc.unicast_ps(params.net_memory, owner, home,
                                  params.line_size + CTRL_BYTES, p_net_own,
-                                 params.mesh_width)
+                                 params.mesh_width, vnet=vp.net_memory)
             owner_ps = jnp.where(owner_leg, leg_ps, 0)
 
         need_read = win & act.dram_read
@@ -1292,11 +1297,11 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             local_ctl = home == dsite
             to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
                 params.net_memory, home, dsite, CTRL_BYTES, p_net_home,
-                params.mesh_width))
+                params.mesh_width, vnet=vp.net_memory))
             from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
                 params.net_memory, dsite, home,
                 params.line_size + CTRL_BYTES, p_net[dsite],
-                params.mesh_width))
+                params.mesh_width, vnet=vp.net_memory))
         else:
             dsite = home
             to_dram_ps = from_dram_ps = jnp.int64(0)
@@ -1335,7 +1340,8 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
         if contended:
             rr = noc_flight.flight(
                 params.net_memory, params.mesh_width, params.mesh_height,
-                home, rows32, t_data, flits_data, win, lf, p_net_home)
+                home, rows32, t_data, flits_data, win, lf, p_net_home,
+                vnet=vp.net_memory)
             lf = rr.link_free
             link_wait = link_wait + rr.wait_ps
             reply_done = rr.arrival
@@ -1344,14 +1350,14 @@ def resolve_memory(params: SimParams, state: SimState) -> SimState:
             reply_done = t_data + reply_ps
 
         l1_fill_ps = jnp.where(
-            is_if, _lat(params.l1i.access_cycles,
+            is_if, _lat(vp.l1i_access_cycles,
                         _period(state, DVFSModule.L1_ICACHE)),
-            _lat(params.l1d.access_cycles, p_l1))
+            _lat(vp.l1d_access_cycles, p_l1))
         if params.shared_l2:
             # No private L2 to fill through on the requester side.
             completion = reply_done + l1_fill_ps + extra
         else:
-            l2_fill_ps = _lat(params.l2.access_cycles, p_l2)
+            l2_fill_ps = _lat(vp.l2_access_cycles, p_l2)
             completion = reply_done + l2_fill_ps + l1_fill_ps \
                 + extra
 
@@ -1929,7 +1935,8 @@ def _sh_l1_evict_notify(params: SimParams, state: SimState, tiles, vtag,
 
 # ====================================================================== sync
 
-def resolve_recv(params: SimParams, state: SimState) -> SimState:
+def resolve_recv(params: SimParams, vp: VariantParams,
+                 state: SimState) -> SimState:
     T = params.num_tiles
     rows = jnp.arange(T)
     D = state.ch_time.shape[0]
@@ -1958,7 +1965,8 @@ def resolve_recv(params: SimParams, state: SimState) -> SimState:
     return _unblock(state, ok, completion, sync=True)
 
 
-def resolve_send(params: SimParams, state: SimState) -> SimState:
+def resolve_send(params: SimParams, vp: VariantParams,
+                 state: SimState) -> SimState:
     """Complete sends that were back-pressured by a full channel ring."""
     T = params.num_tiles
     rows = jnp.arange(T)
@@ -1970,7 +1978,7 @@ def resolve_send(params: SimParams, state: SimState) -> SimState:
     p_nu = _period(state, DVFSModule.NETWORK_USER)
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
     net_ps = noc.unicast_ps(params.net_user, rows, dst, state.pend_addr,
-                            p_nu, params.mesh_width)
+                            p_nu, params.mesh_width, vnet=vp.net_user)
     slot = state.ch_sent[rows, dst] % D
     # Floor at the time the reused ring slot was actually freed (the
     # consuming recv's completion, stored into the slot by resolve_recv) —
@@ -1990,11 +1998,12 @@ def resolve_send(params: SimParams, state: SimState) -> SimState:
             net_user_flits=state.counters.net_user_flits + jnp.where(
                 ok & state.models_enabled,
                 noc.num_flits(state.pend_addr,
-                              params.net_user.flit_width_bits), 0)))
+                              vp.net_user.flit_width_bits), 0)))
     return _unblock(state, ok, completion, sync=True)
 
 
-def resolve_barrier(params: SimParams, state: SimState) -> SimState:
+def resolve_barrier(params: SimParams, vp: VariantParams,
+                    state: SimState) -> SimState:
     T = params.num_tiles
     rows = jnp.arange(T)
     NB = state.bar_count.shape[0]
@@ -2007,7 +2016,8 @@ def resolve_barrier(params: SimParams, state: SimState) -> SimState:
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
     back_ps = noc.unicast_ps(params.net_user,
                              jnp.full(T, mcp_tile(params)), rows, CTRL_BYTES,
-                             p_nu[mcp_tile(params)], params.mesh_width)
+                             p_nu[mcp_tile(params)], params.mesh_width,
+                             vnet=vp.net_user)
     completion = state.bar_time[bid] + back_ps + cycle_ps
     if state.sched_enabled:
         # Wake DESCHEDULED waiters of released barriers directly in the
@@ -2037,7 +2047,8 @@ def resolve_barrier(params: SimParams, state: SimState) -> SimState:
     return _unblock(state, rel, completion, sync=True)
 
 
-def resolve_mutex(params: SimParams, state: SimState) -> SimState:
+def resolve_mutex(params: SimParams, vp: VariantParams,
+                  state: SimState) -> SimState:
     T = params.num_tiles
     rows = jnp.arange(T)
     NL = state.lock_holder.shape[0]
@@ -2054,9 +2065,11 @@ def resolve_mutex(params: SimParams, state: SimState) -> SimState:
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
     mcp = mcp_tile(params)
     to_mcp = noc.unicast_ps(params.net_user, rows, jnp.full(T, mcp),
-                            CTRL_BYTES, p_nu, params.mesh_width)
+                            CTRL_BYTES, p_nu, params.mesh_width,
+                            vnet=vp.net_user)
     from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
-                              CTRL_BYTES, p_nu[mcp], params.mesh_width)
+                              CTRL_BYTES, p_nu[mcp], params.mesh_width,
+                              vnet=vp.net_user)
     grant = jnp.maximum(issue + to_mcp, state.lock_free_at[lid])
     completion = grant + from_mcp + cycle_ps
     lid_eff = jnp.where(win, lid, NL)
@@ -2069,7 +2082,8 @@ def resolve_mutex(params: SimParams, state: SimState) -> SimState:
     return _unblock(state, win, completion, sync=True)
 
 
-def resolve_cond(params: SimParams, state: SimState) -> SimState:
+def resolve_cond(params: SimParams, vp: VariantParams,
+                 state: SimState) -> SimState:
     """Match parked cond waiters with parked signal/broadcast tokens.
 
     Semantics (reference SimCond, sync_server.cc:67-119): the POSTER of a
@@ -2155,7 +2169,7 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
     mcp = mcp_tile(params)
     to_mcp = noc.unicast_ps(params.net_user, rows,
                             jnp.full(T, mcp), CTRL_BYTES,
-                            p_nu, params.mesh_width)
+                            p_nu, params.mesh_width, vnet=vp.net_user)
 
     # Token resolution: a signal completes when it woke someone, or when
     # provably lost; a broadcast completes once no earlier park can still
@@ -2264,7 +2278,8 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
 
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
     from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
-                              CTRL_BYTES, p_nu[mcp], params.mesh_width)
+                              CTRL_BYTES, p_nu[mcp], params.mesh_width,
+                              vnet=vp.net_user)
 
     # Wake waiters: transform into mutex re-acquires; pend_issue is set so
     # resolve_mutex's (issue + to_mcp) lands exactly at the token time.
@@ -2284,7 +2299,8 @@ def resolve_cond(params: SimParams, state: SimState) -> SimState:
     return _unblock(state, tok_done, t + from_mcp + cycle_ps, sync=True)
 
 
-def resolve_join(params: SimParams, state: SimState) -> SimState:
+def resolve_join(params: SimParams, vp: VariantParams,
+                 state: SimState) -> SimState:
     """Release joiners whose child stream has reached DONE (reference:
     ThreadManager join protocol via the MCP, thread_manager.cc)."""
     T = params.num_tiles
@@ -2306,9 +2322,11 @@ def resolve_join(params: SimParams, state: SimState) -> SimState:
     cycle_ps = _lat(1, _period(state, DVFSModule.CORE))
     mcp = mcp_tile(params)
     to_mcp = noc.unicast_ps(params.net_user, rows, jnp.full(T, mcp),
-                            CTRL_BYTES, p_nu, params.mesh_width)
+                            CTRL_BYTES, p_nu, params.mesh_width,
+                            vnet=vp.net_user)
     from_mcp = noc.unicast_ps(params.net_user, jnp.full(T, mcp), rows,
-                              CTRL_BYTES, p_nu[mcp], params.mesh_width)
+                              CTRL_BYTES, p_nu[mcp], params.mesh_width,
+                              vnet=vp.net_user)
     exit_at_mcp = child_done_at + to_mcp[child % T]
     completion = jnp.maximum(state.pend_issue + to_mcp, exit_at_mcp) \
         + from_mcp + cycle_ps
@@ -2318,7 +2336,8 @@ def resolve_join(params: SimParams, state: SimState) -> SimState:
     return _unblock(state, ok, completion, sync=True)
 
 
-def resolve_start(params: SimParams, state: SimState) -> SimState:
+def resolve_start(params: SimParams, vp: VariantParams,
+                  state: SimState) -> SimState:
     """Release THREAD_START gates whose stream has been SPAWNed
     (spawned_at is stream-indexed; the seat's stream id maps it)."""
     is_s = state.pend_kind == PEND_START
@@ -2332,7 +2351,7 @@ def resolve_start(params: SimParams, state: SimState) -> SimState:
     return _unblock(state, ok, completion, sync=True)
 
 
-def _when_pending(kind: int, fn, params: SimParams,
+def _when_pending(kind: int, fn, params: SimParams, vp: VariantParams,
                   state: SimState) -> SimState:
     """Run a resolver only if some tile is parked on its pend kind —
     `lax.cond` skips the resolver's gathers/scatters entirely otherwise
@@ -2340,10 +2359,11 @@ def _when_pending(kind: int, fn, params: SimParams,
     result-identical)."""
     return jax.lax.cond(
         (state.pend_kind == kind).any(),
-        lambda s: fn(params, s), lambda s: s, state)
+        lambda s: fn(params, vp, s), lambda s: s, state)
 
 
-def resolve(params: SimParams, state: SimState) -> SimState:
+def resolve(params: SimParams, state: SimState,
+            vp: VariantParams = None) -> SimState:
     """One full cross-tile resolution pass.  resolve_cond runs before
     resolve_mutex so a freshly-woken waiter competes for its mutex
     re-acquire in the same pass.
@@ -2352,7 +2372,12 @@ def resolve(params: SimParams, state: SimState) -> SimState:
     ``lax.cond`` costs pass-through buffer copies of the whole state on
     TPU, so per-kind gating (round 2's shape) paid ~7 state copies per
     sub-round; the per-kind resolvers are no-ops on empty masks anyway.
+
+    ``vp`` threads the VARIANT timing operands (engine/vparams.py);
+    omitted, it derives from ``params`` and traces as constants.
     """
+    if vp is None:
+        vp = variant_params(params)
     if params.miss_chain > 0:
         any_mem = (state.mq_count > 0).any()
     else:
@@ -2360,26 +2385,26 @@ def resolve(params: SimParams, state: SimState) -> SimState:
                    | (state.pend_kind == PEND_EX_REQ)
                    | (state.pend_kind == PEND_IFETCH)).any()
     state = jax.lax.cond(
-        any_mem, lambda s: resolve_memory(params, s), lambda s: s, state)
+        any_mem, lambda s: resolve_memory(params, vp, s), lambda s: s, state)
 
     def sync_pass(s: SimState) -> SimState:
         if s.has_capi:
             # Traces with no CAPI traffic carry zero-size channel arrays
             # (see make_state) — these resolvers would index them, and no
             # tile can park on RECV/SEND without CAPI events in the trace.
-            s = _when_pending(PEND_RECV, resolve_recv, params, s)
-            s = _when_pending(PEND_SEND, resolve_send, params, s)
-        s = _when_pending(PEND_BARRIER, resolve_barrier, params, s)
+            s = _when_pending(PEND_RECV, resolve_recv, params, vp, s)
+            s = _when_pending(PEND_SEND, resolve_send, params, vp, s)
+        s = _when_pending(PEND_BARRIER, resolve_barrier, params, vp, s)
         # Cond resolution runs whenever waiters OR tokens are parked (a
         # lost signal must still expire and ack its poster with no waiter
         # around).
         s = jax.lax.cond(
             ((s.pend_kind == PEND_COND) | (s.pend_kind == PEND_CSIG)
              | (s.pend_kind == PEND_CBC)).any(),
-            lambda x: resolve_cond(params, x), lambda x: x, s)
-        s = _when_pending(PEND_MUTEX, resolve_mutex, params, s)
-        s = _when_pending(PEND_JOIN, resolve_join, params, s)
-        s = _when_pending(PEND_START, resolve_start, params, s)
+            lambda x: resolve_cond(params, vp, x), lambda x: x, s)
+        s = _when_pending(PEND_MUTEX, resolve_mutex, params, vp, s)
+        s = _when_pending(PEND_JOIN, resolve_join, params, vp, s)
+        s = _when_pending(PEND_START, resolve_start, params, vp, s)
         return s
 
     any_sync = (state.pend_kind >= PEND_RECV).any()   # every non-memory kind

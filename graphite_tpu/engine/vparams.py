@@ -1,0 +1,125 @@
+"""VARIANT-parameter operands — the sweep engine's timing-constant pytree.
+
+``SimParams`` is a jit-STATIC argument: every numeric it carries is baked
+into the compiled program as a constant, so two configs differing only in
+a DRAM latency compile two programs.  The sweep engine
+(graphite_tpu/sweep) instead runs V config variants of one trace as a
+single ``vmap``ped invocation — which requires every timing constant that
+may vary across a batch to enter the engine as a traced OPERAND, not a
+constant.
+
+``VariantParams`` is that operand pytree: the derived integer timing
+scalars the engine's math actually consumes (access latencies in cycles,
+DRAM ps, NoC delays, flit widths, quantum lengths, syscall costs), one
+jnp scalar per leaf.  ``variant_params(params)`` derives it host-side
+from a ``SimParams`` — derivations (perf-model max-vs-sum, bandwidth ->
+ps-per-line rounding) happen HERE in exact Python integer math, so the
+engine stays all-integer and a vmapped lane is bit-identical to a serial
+run of the same config.
+
+Which ``SimParams`` leaves are VARIANT (operand-safe) vs STRUCTURAL
+(shape/program-bearing, must match within a batch) is declared in
+graphite_tpu/sweep/space.py; this module only carries the operands.
+
+The single-run path derives ``VariantParams`` inside the jitted wrappers
+(engine/quantum.megarun/megastep), where the leaves trace as constants —
+the compiled program and results are exactly the pre-sweep engine's.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from graphite_tpu.params import NetworkParams, SimParams
+
+
+class NetVariant(NamedTuple):
+    """One logical network's VARIANT timing operands (int32 scalars).
+
+    The model SELECTION (magic/emesh/atac, routing strategy, receive-net
+    type) stays structural in ``NetworkParams``; only the numeric delays
+    and widths ride here.  ATAC fields are zero when the network is not
+    an ATAC model (never read then)."""
+
+    flit_width_bits: jnp.ndarray
+    router_delay_cycles: jnp.ndarray
+    link_delay_cycles: jnp.ndarray
+    atac_send_hub_delay: jnp.ndarray
+    atac_receive_hub_delay: jnp.ndarray
+    atac_star_delay: jnp.ndarray
+    atac_optical_cycles: jnp.ndarray
+    atac_unicast_threshold: jnp.ndarray
+
+
+def net_variant(net: NetworkParams) -> NetVariant:
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    a = net.atac
+    return NetVariant(
+        flit_width_bits=i32(net.flit_width_bits),
+        router_delay_cycles=i32(net.router_delay_cycles),
+        link_delay_cycles=i32(net.link_delay_cycles),
+        atac_send_hub_delay=i32(a.send_hub_router_delay if a else 0),
+        atac_receive_hub_delay=i32(a.receive_hub_router_delay if a else 0),
+        atac_star_delay=i32(a.star_net_router_delay if a else 0),
+        atac_optical_cycles=i32(a.optical_link_delay_cycles if a else 0),
+        atac_unicast_threshold=i32(a.unicast_distance_threshold if a else 0),
+    )
+
+
+class VariantParams(NamedTuple):
+    """Traced timing operands of one simulation run (scalars; [V]-leading
+    under the sweep engine's vmap)."""
+
+    # Quantum cadence (ps).
+    quantum_ps: jnp.ndarray               # int64
+    thread_switch_quantum_ps: jnp.ndarray  # int64
+    # Core.
+    bp_mispredict_penalty: jnp.ndarray    # int32 cycles
+    dvfs_sync_delay_cycles: jnp.ndarray   # int32 cycles
+    syscall_cost_cycles: jnp.ndarray      # int32 [len(SyscallClass)]
+    # Cache hit/tag latencies (cycles; perf-model max/sum pre-applied).
+    l1i_access_cycles: jnp.ndarray        # int32
+    l1d_access_cycles: jnp.ndarray        # int32
+    l2_access_cycles: jnp.ndarray         # int32
+    l2_tags_access_cycles: jnp.ndarray    # int32
+    # Directory.
+    dir_access_cycles: jnp.ndarray        # int32
+    limitless_trap_cycles: jnp.ndarray    # int32
+    # DRAM (ps; bandwidth -> serialization pre-derived per line).
+    dram_latency_ps: jnp.ndarray          # int64
+    dram_processing_ps: jnp.ndarray       # int64 per cache line
+    # NoCs.
+    net_user: NetVariant
+    net_memory: NetVariant
+
+
+def variant_params(params: SimParams) -> VariantParams:
+    """Derive the operand pytree from a (host-side) ``SimParams``.
+
+    All leaves are exact integers computed with the same Python math the
+    engine's constants used before the sweep engine existed, so baking
+    them (serial path) and batching them (sweep path) give bit-identical
+    results."""
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    i64 = lambda v: jnp.asarray(v, jnp.int64)
+    return VariantParams(
+        quantum_ps=i64(params.quantum_ps),
+        thread_switch_quantum_ps=i64(params.thread_switch_quantum_ps),
+        bp_mispredict_penalty=i32(params.core.bp_mispredict_penalty),
+        dvfs_sync_delay_cycles=i32(params.dvfs_sync_delay_cycles),
+        syscall_cost_cycles=jnp.asarray(params.syscall_cost_cycles,
+                                        dtype=jnp.int32),
+        l1i_access_cycles=i32(params.l1i.access_cycles),
+        l1d_access_cycles=i32(params.l1d.access_cycles),
+        l2_access_cycles=i32(params.l2.access_cycles),
+        l2_tags_access_cycles=i32(params.l2.tags_access_cycles),
+        dir_access_cycles=i32(params.directory.access_cycles),
+        limitless_trap_cycles=i32(params.directory.limitless_trap_cycles),
+        dram_latency_ps=i64(params.dram.latency_ps),
+        dram_processing_ps=i64(
+            params.dram.processing_ps_per_line(params.line_size)),
+        net_user=net_variant(params.net_user),
+        net_memory=net_variant(params.net_memory),
+    )

@@ -1,0 +1,22 @@
+"""Design-space sweep engine: V config variants of one trace as a single
+vmapped device program.
+
+Graphite's whole purpose is architecture design-space exploration (Miller
+et al., HPCA 2010): the same workload under dozens of latency/bandwidth/
+frequency points.  Serially that costs V XLA compiles and V engine runs;
+here the VARIANT numeric leaves of ``SimParams`` ride the engine as
+batched operands (engine/vparams.py) so one compiled program serves the
+whole batch, V variants advance per device dispatch, and each variant's
+results are bit-identical to its solo run.
+
+  * ``space``  — STRUCTURAL/VARIANT leaf partition + sweep-spec parsing
+  * ``batch``  — variant stacking, the vmapped megarun, result fan-out
+  * ``driver`` — request queue bucketing submissions by structural
+                 signature, pow2 padding, compile-cache accounting
+"""
+
+from graphite_tpu.sweep.batch import SweepSimulator, run_sweep  # noqa: F401
+from graphite_tpu.sweep.driver import SweepDriver  # noqa: F401
+from graphite_tpu.sweep.space import (  # noqa: F401
+    STRUCTURAL_LEAVES, VARIANT_LEAVES, build_variants, iter_leaves,
+    parse_sweep_spec, structural_signature)

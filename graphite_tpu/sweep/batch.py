@@ -1,0 +1,200 @@
+"""Batched multi-variant execution: V ``SimParams`` variants of one trace
+through ONE vmapped ``megarun`` program.
+
+Mechanics:
+
+  * Per-variant init states (``make_state`` — DVFS periods and the first
+    quantum boundary are the state-borne variant leaves) and per-variant
+    ``VariantParams`` operand pytrees are stacked leaf-wise into
+    [V]-leading batches.
+  * ``sweep_megarun`` vmaps the engine's ``megarun_loop`` over (state,
+    operands) with the trace broadcast.  The loop body is masked on each
+    lane's ``all_done`` (engine/quantum.megarun_loop), so the device
+    loop runs to the SLOWEST variant while finished lanes stay frozen
+    bit-exactly.
+  * The jit-static argument is the CANONICAL params (sweep/space.py):
+    variant values live only in the batched operands, so one compiled
+    program serves every design point of a structural bucket.
+  * Results fan back out: each lane slices to an ordinary ``SimState``
+    and renders through the ordinary ``SimSummary``.
+
+Bit-identity contract (tests/test_sweep.py, bench.py
+``sweep_matches_serial``): lane i of a sweep equals a solo
+``Simulator`` run of variant i — final clocks, every counter, every
+phase counter — because both paths run the same integer math over the
+same values; vmap only adds the batch axis.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.engine.quantum import megarun_loop
+from graphite_tpu.engine.sim import DeadlockError, SimSummary
+from graphite_tpu.engine.state import SimState, TraceArrays, make_state
+from graphite_tpu.engine.vparams import variant_params
+from graphite_tpu.events.schema import Trace
+from graphite_tpu.params import SimParams
+from graphite_tpu.sweep.space import (canonical_params, structural_diff,
+                                      structural_signature)
+
+# In-process compile accounting: bumped when the batched program is
+# TRACED (tracing happens exactly once per jit cache miss — i.e. per
+# compile request this process makes), never on cache hits.  The sweep
+# driver and the CI smoke gate assert on deltas of this counter: one
+# compile per structural bucket shape.
+_COMPILE_COUNT = 0
+
+
+def compile_count() -> int:
+    return _COMPILE_COUNT
+
+
+def _count_trace():
+    global _COMPILE_COUNT
+    _COMPILE_COUNT += 1
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def sweep_megarun(canon: SimParams, bstate, bvp, trace: TraceArrays,
+                  max_quanta):
+    """One device dispatch advancing every variant up to ``max_quanta``
+    quanta (or its own completion).  ``canon`` must be the CANONICAL
+    params of the bucket (space.canonical_params) so the jit cache keys
+    on structure, not on visited design points."""
+    _count_trace()
+
+    def one(st, vp):
+        return megarun_loop(canon, vp, st, trace, max_quanta)
+
+    return jax.vmap(one, in_axes=(0, 0))(bstate, bvp)
+
+
+def _stack(trees):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _lane(btree, i: int):
+    return jax.tree_util.tree_map(lambda x: x[i], btree)
+
+
+def _batched_all_done(bstate) -> np.ndarray:
+    return np.asarray(jax.vmap(lambda s: s.all_done())(bstate))
+
+
+class SweepSimulator:
+    """The ``Simulator`` shape, over V variants at once.
+
+    All variants must share one structural signature (checked; the
+    driver's bucketing guarantees it for queued submissions) and run the
+    SAME trace — that is the sweep contract: one workload, many machine
+    timings.
+    """
+
+    def __init__(self, variants: List[SimParams], trace: Trace):
+        if not variants:
+            raise ValueError("sweep needs at least one variant")
+        base = variants[0]
+        sig = structural_signature(base)
+        for p in variants[1:]:
+            if structural_signature(p) != sig:
+                raise ValueError(
+                    "sweep variants differ structurally: "
+                    + "; ".join(structural_diff(base, p)[:8]))
+        if trace.num_tiles < base.num_tiles:
+            raise ValueError(
+                f"trace has {trace.num_tiles} streams, params expect "
+                f"at least {base.num_tiles}")
+        from graphite_tpu.isa import EventOp
+        ops = np.asarray(trace.ops)
+        has_capi = bool(((ops == int(EventOp.SEND))
+                         | (ops == int(EventOp.RECV))).any())
+        if has_capi and trace.num_tiles > base.num_tiles:
+            raise ValueError(
+                "CAPI SEND/RECV with multi-thread-per-core scheduling is "
+                "not supported yet (channel state is tile-addressed)")
+        self.variants = list(variants)
+        self.canon = canonical_params(base)
+        self.trace = TraceArrays.from_trace(trace)
+        self.bstate = _stack([
+            make_state(p, has_capi=has_capi, num_streams=trace.num_tiles)
+            for p in variants])
+        self.bvp = _stack([variant_params(p) for p in variants])
+        self.steps = 0
+        self.host_seconds = 0.0
+
+    @property
+    def num_variants(self) -> int:
+        return len(self.variants)
+
+    def run(self, max_steps: Optional[int] = None,
+            poll_every: int = 8) -> List[SimSummary]:
+        """Run windows until EVERY variant is done (or max_steps); one
+        SimSummary per variant, in submission order."""
+        from graphite_tpu.log import get_logger
+        from graphite_tpu.obs import span
+        lg = get_logger("sweep")
+        base = self.variants[0]
+        lg.info("sweep: %d variants x %d tiles, %d events/tile",
+                self.num_variants, base.num_tiles, self.trace.num_events)
+        t0 = time.perf_counter()
+        qps = base.quanta_per_step
+        last_progress = None
+        first_dispatch = True
+        quanta_v = np.zeros(self.num_variants, dtype=np.int64)
+        while True:
+            window = poll_every if max_steps is None \
+                else max(min(poll_every, max_steps - self.steps), 0)
+            if window == 0:
+                break
+            with span("sweep.compile+window" if first_dispatch
+                      else "sweep.window",
+                      quanta=window * qps, variants=self.num_variants):
+                self.bstate = sweep_megarun(
+                    self.canon, self.bstate, self.bvp, self.trace,
+                    window * qps)
+                done_v = _batched_all_done(self.bstate)
+                cursor_sum, clock_sum, quanta_v = jax.device_get(
+                    (self.bstate.cursor.sum(), self.bstate.clock.sum(),
+                     self.bstate.ctr_quantum))
+            first_dispatch = False
+            # The device loop runs to the slowest variant; window
+            # accounting follows that lane.
+            self.steps = -(-int(np.max(quanta_v)) // qps)
+            if bool(done_v.all()):
+                break
+            if max_steps is not None and self.steps >= max_steps:
+                break
+            progress = (int(cursor_sum), int(clock_sum))
+            if progress == last_progress:
+                stuck = [i for i, d in enumerate(done_v) if not d]
+                raise DeadlockError(
+                    f"no progress after {self.steps} steps "
+                    f"(undone variants: {stuck})")
+            last_progress = progress
+        self.host_seconds = time.perf_counter() - t0
+        lg.info("sweep finished: %d variants, quanta %s, %.2f host-s",
+                self.num_variants, np.asarray(quanta_v).tolist(),
+                self.host_seconds)
+        return self.summaries()
+
+    def summaries(self) -> List[SimSummary]:
+        """Fan the batched final state out into V independent summaries.
+        ``host_seconds`` is the whole batch's wall clock (the variants
+        ran together — per-variant host time is not separable).  Lanes
+        slice as device arrays so SimSummary's seat-patching (.at[]) and
+        int() coercions behave exactly as on a solo run's state."""
+        return [SimSummary(self.variants[i], _lane(self.bstate, i),
+                           self.host_seconds, self.steps)
+                for i in range(self.num_variants)]
+
+
+def run_sweep(variants: List[SimParams], trace: Trace,
+              max_steps: Optional[int] = None) -> List[SimSummary]:
+    return SweepSimulator(variants, trace).run(max_steps=max_steps)

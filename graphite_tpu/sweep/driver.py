@@ -1,0 +1,119 @@
+"""Sweep request queue: bucket submissions, pad, batch, account compiles.
+
+The engine's compile cost is per PROGRAM, not per design point: a sweep
+batch's jit key is (canonical structural params, batch width V, trace
+shape).  This driver keeps that cache bounded and observable:
+
+  * submissions queue up and are grouped by STRUCTURAL SIGNATURE
+    (sweep/space.py) — variants that could not share a program never
+    land in one batch;
+  * each bucket pads to a power-of-two V (repeating its last variant) so
+    arbitrary submission counts collapse onto log2-many batch widths —
+    3, 5, or 7 variants all run the V=8 program;
+  * a compile-accounting assertion: draining a bucket whose
+    (signature, V) shape already compiled this process must NOT compile
+    again (batch.compile_count() is bumped per jit trace, i.e. per
+    in-process compile request).  A violation means variant values
+    leaked into the static argument — the exact regression the
+    canonical-params design exists to prevent.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from graphite_tpu.engine.sim import SimSummary
+from graphite_tpu.events.schema import Trace
+from graphite_tpu.params import SimParams
+from graphite_tpu.sweep import batch as batchmod
+from graphite_tpu.sweep.batch import SweepSimulator
+from graphite_tpu.sweep.space import structural_signature
+
+
+def _ceil_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class SweepDriver:
+    """Queue variants of ONE trace; drain them as padded vmapped batches.
+
+    Usage::
+
+        drv = SweepDriver(trace)
+        tickets = [drv.submit(p) for p in variant_params_list]
+        results = drv.drain()          # {ticket: SimSummary}
+    """
+
+    def __init__(self, trace: Trace, max_steps: Optional[int] = None,
+                 poll_every: int = 8):
+        self.trace = trace
+        self.max_steps = max_steps
+        self.poll_every = poll_every
+        self._pending: List[Tuple[int, SimParams]] = []
+        self._next_ticket = 0
+        # (structural signature, padded V) shapes already compiled by
+        # THIS driver's process — the compile-cache bound being asserted.
+        self._compiled_shapes: set = set()
+        self.compiles_observed = 0
+
+    def submit(self, params: SimParams) -> int:
+        """Queue one variant; returns a ticket redeemable at drain()."""
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._pending.append((ticket, params))
+        return ticket
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def drain(self) -> Dict[int, SimSummary]:
+        """Run every queued variant; {ticket: SimSummary}.  Buckets run
+        in first-submission order; within a bucket, results keep
+        submission order (padding lanes are dropped).  Submissions leave
+        the queue only as their bucket COMPLETES — a mid-drain failure
+        (a DeadlockError in one bucket) leaves the failed and not-yet-run
+        buckets queued for a retry drain instead of discarding them."""
+        buckets: Dict[tuple, List[Tuple[int, SimParams]]] = {}
+        order: List[tuple] = []
+        for ticket, p in self._pending:
+            sig = structural_signature(p)
+            if sig not in buckets:
+                buckets[sig] = []
+                order.append(sig)
+            buckets[sig].append((ticket, p))
+
+        results: Dict[int, SimSummary] = {}
+        for sig in order:
+            items = buckets[sig]
+            v = len(items)
+            vpad = _ceil_pow2(v)
+            variants = [p for _, p in items]
+            # Pad with copies of the last variant: identical timing math,
+            # lanes discarded below — the pow2 width is what bounds the
+            # compile cache.
+            variants += [variants[-1]] * (vpad - v)
+            shape_key = (sig, vpad, self.trace.ops.shape)
+            before = batchmod.compile_count()
+            sim = SweepSimulator(variants, self.trace)
+            summaries = sim.run(max_steps=self.max_steps,
+                                poll_every=self.poll_every)
+            compiled = batchmod.compile_count() - before
+            self.compiles_observed += compiled
+            if shape_key in self._compiled_shapes and compiled != 0:
+                raise AssertionError(
+                    f"sweep bucket shape recompiled ({compiled} traces) "
+                    f"although (signature, V={vpad}) already compiled — "
+                    f"variant values leaked into the jit-static argument")
+            if compiled > 1:
+                raise AssertionError(
+                    f"sweep bucket compiled {compiled} programs; the "
+                    f"batched megarun must compile exactly once per "
+                    f"bucket shape")
+            self._compiled_shapes.add(shape_key)
+            done_tickets = set()
+            for (ticket, _), summary in zip(items, summaries[:v]):
+                results[ticket] = summary
+                done_tickets.add(ticket)
+            self._pending = [(t, p) for t, p in self._pending
+                             if t not in done_tickets]
+        return results

@@ -1,0 +1,223 @@
+"""Sweep engine (graphite_tpu/sweep): vmapped multi-variant simulation.
+
+The contract under test:
+
+  * **Bit-identity** — lane i of a V-variant sweep equals a solo
+    ``Simulator`` run of variant i: per-tile final clocks, every
+    counter, and the quantum count.  Both paths execute the same
+    integer math with the same values (the VARIANT leaves enter as
+    operands either way — engine/vparams.py); vmap only adds the batch
+    axis.
+  * **One compile per bucket shape** — variant VALUES live in batched
+    operands, the jit-static argument is the canonicalized structural
+    params, so a bucket of any V design points compiles exactly one
+    program (batch.compile_count() is bumped per jit trace).
+  * **Leaf-partition completeness** — every numeric ``SimParams`` leaf
+    is declared STRUCTURAL or VARIANT (sweep/space.py); a new field
+    cannot silently join a batch and break vmap safety.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigError, load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+from graphite_tpu.sweep import (SweepDriver, build_variants, iter_leaves,
+                                parse_sweep_spec, structural_signature)
+from graphite_tpu.sweep import batch as batchmod
+from graphite_tpu.sweep.space import (STRUCTURAL_LEAVES, VARIANT_LEAVES,
+                                      classify, is_numeric_leaf)
+
+pytestmark = pytest.mark.quick
+
+
+def _params(**overrides) -> SimParams:
+    cfg = load_config()
+    for k, v in overrides.items():
+        cfg.set(k.replace(".", "/"), v)
+    return SimParams.from_config(cfg)
+
+
+# ------------------------------------------------------- leaf partition
+
+def _param_zoo():
+    """SimParams across the model space, so optional sub-trees (atac,
+    iocoom) contribute their leaves to the completeness walk."""
+    return [
+        _params(**{"general/total_cores": 4}),
+        _params(**{"general/total_cores": 4,
+                   "caching_protocol/type": "pr_l1_sh_l2_mesi"}),
+        _params(**{"general/total_cores": 4,
+                   "tile/model_list": "<default, iocoom, T1, T1, T1>"}),
+        _params(**{"general/total_cores": 16,
+                   "network/memory": "atac", "network/user": "atac"}),
+        _params(**{"general/total_cores": 4,
+                   "dram_directory/directory_type": "limitless",
+                   "dram_directory/max_hw_sharers": 2}),
+    ]
+
+
+def test_leaf_partition_complete():
+    """Every numeric SimParams leaf is classified; the sets are disjoint
+    and contain no stale paths."""
+    assert not (VARIANT_LEAVES & STRUCTURAL_LEAVES)
+    seen = set()
+    for p in _param_zoo():
+        for path, value in iter_leaves(p):
+            # classify() raises on an unclassified numeric leaf — THE
+            # tripwire for new SimParams fields.
+            kind = classify(path, value)
+            seen.add(path)
+            if path in VARIANT_LEAVES:
+                assert kind == "variant"
+            if is_numeric_leaf(value):
+                assert path in VARIANT_LEAVES or path in STRUCTURAL_LEAVES
+    # No stale declarations: every declared numeric leaf must exist on
+    # some buildable config (a renamed field would otherwise keep its
+    # ghost classification forever).
+    for path in VARIANT_LEAVES | STRUCTURAL_LEAVES:
+        assert path in seen, f"declared leaf {path!r} never occurs"
+
+
+def test_unclassified_leaf_trips():
+    with pytest.raises(ConfigError, match="neither STRUCTURAL nor VARIANT"):
+        classify("no_such.leaf_path", 7)
+
+
+def test_structural_signature_groups():
+    a = _params(**{"general/total_cores": 4, "dram/latency": 100})
+    b = _params(**{"general/total_cores": 4, "dram/latency": 140})
+    c = _params(**{"general/total_cores": 4, "tpu/block_events": 4})
+    assert structural_signature(a) == structural_signature(b)
+    assert structural_signature(a) != structural_signature(c)
+
+
+# ---------------------------------------------------------- spec parser
+
+def test_parse_sweep_spec_cross_and_zip():
+    pts = parse_sweep_spec(["dram/latency=80,120",
+                            "l2_cache/T1/data_access_time=6,8"])
+    assert len(pts) == 4
+    assert pts[0] == {"dram/latency": "80",
+                      "l2_cache/T1/data_access_time": "6"}
+    assert pts[-1] == {"dram/latency": "120",
+                       "l2_cache/T1/data_access_time": "8"}
+    zipped = parse_sweep_spec(
+        ["dram/latency=80,120;dram/per_controller_bandwidth=4,8"])
+    assert len(zipped) == 2
+    assert zipped[1] == {"dram/latency": "120",
+                         "dram/per_controller_bandwidth": "8"}
+
+
+def test_parse_sweep_spec_errors():
+    with pytest.raises(ConfigError, match="section/key"):
+        parse_sweep_spec(["latency=80,120"])
+    with pytest.raises(ConfigError, match="values"):
+        parse_sweep_spec(["dram/latency=80,,120"])
+    with pytest.raises(ConfigError, match="expected 2"):
+        parse_sweep_spec(["dram/latency=80,120;dram/per_controller_bandwidth=4"])
+    with pytest.raises(ConfigError, match="more than one axis"):
+        parse_sweep_spec(["dram/latency=80", "dram/latency=120"])
+
+
+def test_structural_sweep_rejected():
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    with pytest.raises(ConfigError, match="STRUCTURAL"):
+        build_variants(cfg, ["tpu/block_events=4,16"])
+
+
+# --------------------------------------------- sweep vs serial identity
+
+def _assert_lane_equals_solo(lane, solo, label=""):
+    np.testing.assert_array_equal(np.asarray(lane.clock),
+                                  np.asarray(solo.clock), label)
+    assert lane.quanta == solo.quanta, label
+    assert lane.done.all() and solo.done.all(), label
+    for k in lane.counters:
+        np.testing.assert_array_equal(lane.counters[k], solo.counters[k],
+                                      f"{label}.{k}")
+
+
+def test_sweep_v8_radix8_bit_identical_one_compile():
+    """ACCEPTANCE: a V=8 sweep of radix8 — DRAM latency x quantum x L2
+    hit latency — produces per-variant final clocks and all counters
+    bit-identical to 8 serial single-variant runs, with exactly one XLA
+    compile for the bucket."""
+    cfg = load_config()
+    cfg.set("general/total_cores", 8)
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=48, radix=16, seed=3)
+    variants = build_variants(cfg, [
+        "dram/latency=60,120",
+        "clock_skew_management/lax_barrier/quantum=800,1000",
+        "l2_cache/T1/data_access_time=6,8",
+    ])
+    assert len(variants) == 8
+
+    before = batchmod.compile_count()
+    drv = SweepDriver(trace)
+    tickets = [drv.submit(p) for _, _, p in variants]
+    results = drv.drain()
+    assert batchmod.compile_count() - before == 1, \
+        "a structural bucket must compile exactly one program"
+    assert drv.compiles_observed == 1
+
+    clocks = []
+    for (label, _, p), t in zip(variants, tickets):
+        lane = results[t]
+        solo = Simulator(p, trace).run()
+        _assert_lane_equals_solo(lane, solo, label)
+        clocks.append(lane.completion_time_ps)
+    # The sweep must actually sweep: the 8 design points may not all
+    # collapse onto one completion time.
+    assert len(set(clocks)) > 1
+
+
+def test_driver_padding_and_compile_cache():
+    """V=3 submissions pad to the V=4 program; re-draining the same
+    bucket shape must hit the jit cache (zero new compiles)."""
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=1)
+    variants = build_variants(
+        cfg, ["dram/latency=80,100,120"])          # V = 3 -> pad to 4
+    drv = SweepDriver(trace)
+    before = batchmod.compile_count()
+    t1 = [drv.submit(p) for _, _, p in variants]
+    r1 = drv.drain()
+    assert batchmod.compile_count() - before == 1
+    assert sorted(r1) == sorted(t1)
+    # Second drain: same structural signature + padded width -> cached.
+    t2 = [drv.submit(p) for _, _, p in
+          build_variants(cfg, ["dram/latency=90,110,130"])]
+    r2 = drv.drain()
+    assert batchmod.compile_count() - before == 1, \
+        "same bucket shape recompiled — variant values leaked into " \
+        "the static argument"
+    # Different design points, same trace: results differ across drains.
+    assert r2[t2[0]].completion_time_ps != r1[t1[0]].completion_time_ps \
+        or r2[t2[1]].completion_time_ps != r1[t1[1]].completion_time_ps
+
+
+@pytest.mark.slow
+def test_sweep_t64_shape():
+    """T=64 batch shape: lane 0 stays bit-identical to its solo run and
+    completion time is monotone in DRAM latency (the serial comparison
+    is bounded to one lane — 4 solo compiles at T=64 would dominate the
+    slow tier for no extra signal)."""
+    cfg = load_config()
+    cfg.set("general/total_cores", 64)
+    trace = synth.gen_radix(num_tiles=64, keys_per_tile=16, radix=16,
+                            seed=5)
+    variants = build_variants(cfg, ["dram/latency=60,100,140,180"])
+    drv = SweepDriver(trace)
+    tickets = [drv.submit(p) for _, _, p in variants]
+    results = drv.drain()
+    lanes = [results[t] for t in tickets]
+    assert all(lane.done.all() for lane in lanes)
+    solo = Simulator(variants[0][2], trace).run()
+    _assert_lane_equals_solo(lanes[0], solo, "T64 lane0")
+    times = [lane.completion_time_ps for lane in lanes]
+    assert times == sorted(times) and times[0] < times[-1]

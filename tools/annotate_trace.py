@@ -20,9 +20,15 @@ after the fact:
      Instrumentation calls (__tsan_*, probe calls) are excluded from
      the counts — they are capture overhead, not target work.
 
-Usage: python tools/annotate_trace.py BINARY TRACE_IN [TRACE_OUT]
+Usage: python tools/annotate_trace.py [--verbose] BINARY TRACE_IN [TRACE_OUT]
 (defaults to rewriting TRACE_IN in place).  Also importable:
-``annotate(binary, trace) -> trace``.
+``annotate_raw(binary, trace_in) -> (hits, total)``.
+
+Progress chatter ("N/M compute events typed") is silenced unless
+--verbose (or verbose=True): bench.py annotates one capture per row and
+the per-trace lines dominated the visible tail of a timed-out bench
+(BENCH_r05.json).  Anomalies — no static blocks decoded, annotation
+refused under branch thinning — always print.
 """
 
 from __future__ import annotations
@@ -147,7 +153,8 @@ def block_table(binary: str, costs=None):
     return table
 
 
-def annotate_raw(binary: str, trace_in: str, trace_out=None, costs=None):
+def annotate_raw(binary: str, trace_in: str, trace_out=None, costs=None,
+                 verbose: bool = False):
     """Rewrite COMPUTE (cost, icount) in a RAW capture file from the
     binary's block table — BEFORE binio's address compaction remaps the
     recorded pcs (load_binary_trace keeps only page-offset bits of code
@@ -214,21 +221,24 @@ def annotate_raw(binary: str, trace_in: str, trace_out=None, costs=None):
         for rec in per_tile:
             f.write(struct.pack("<I", len(rec)))
             f.write(rec.tobytes())
-    print(f"annotate_trace: {hits}/{total} compute events typed "
-          f"({len(table)} static blocks)", file=sys.stderr)
+    if verbose:
+        print(f"annotate_trace: {hits}/{total} compute events typed "
+              f"({len(table)} static blocks)", file=sys.stderr)
     return hits, total
 
 
 def main(argv):
-    if len(argv) < 3:
+    args = [a for a in argv[1:] if a not in ("--verbose", "-v")]
+    verbose = len(args) != len(argv) - 1
+    if len(args) < 2:
         print(__doc__)
         return 2
-    binary, tin = argv[1], argv[2]
-    tout = argv[3] if len(argv) > 3 else tin
+    binary, tin = args[0], args[1]
+    tout = args[2] if len(args) > 2 else tin
     import os
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    annotate_raw(binary, tin, tout)
+    annotate_raw(binary, tin, tout, verbose=verbose)
     return 0
 
 

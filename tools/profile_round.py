@@ -68,9 +68,11 @@ def main():
         print(f"overrides: {' '.join(overrides)}", flush=True)
 
     from graphite_tpu.engine import quantum
+    from graphite_tpu.engine.vparams import variant_params
+    vp = variant_params(params)
     phases = [
-        ("complex", lambda s, t: _complex_slot(params, s, t)),
-        ("resolve_memory", lambda s, t: rs.resolve_memory(params, s)),
+        ("complex", lambda s, t: _complex_slot(params, vp, s, t)),
+        ("resolve_memory", lambda s, t: rs.resolve_memory(params, vp, s)),
         ("resolve_all", lambda s, t: rs.resolve(params, s)),
         # The full quantum step (local rounds + resolve + boundary +
         # sampling): iterated cost ~= the engine's whole-round floor.
@@ -78,7 +80,7 @@ def main():
     ]
     if params.block_events > 0:
         phases.insert(0, ("block",
-                          lambda s, t: _block_retire(params, s, t)))
+                          lambda s, t: _block_retire(params, vp, s, t)))
     for name, fn in phases:
         us = fused(fn, state, ta, iters)
         print(f"T={T} {name}: {us:.0f} us/round", flush=True)

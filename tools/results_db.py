@@ -16,12 +16,19 @@ Usage:
 
 ``add`` also flags engine-throughput regressions: each ingested row's
 rounds/s (bench ``engine_rounds`` or RunReport ``quanta`` over
-``host_seconds``) AND simulated MIPS are compared against the most
-recent prior run of the same workload, and a drop of more than 20% in
-either prints a ``REGRESSION`` line (exit code stays 0 — the flag is
-for CI greps and humans, not a gate).  Both metrics matter since the
-miss-chain engine trades rounds for heavier rounds: rounds/s alone
-would call that a regression, MIPS alone would hide a fixed-cost one.
+``host_seconds``), simulated MIPS, AND sweep variants/s (bench/cli
+sweep rows: ``variants`` over ``host_seconds``) are compared against
+the most recent prior run of the same workload, and a drop of more
+than 20% in any prints a ``REGRESSION`` line (exit code stays 0 — the
+flag is for CI greps and humans, not a gate).  Multiple metrics matter
+since the miss-chain engine trades rounds for heavier rounds: rounds/s
+alone would call that a regression, MIPS alone would hide a fixed-cost
+one; variants/s is the sweep engine's own unit (config points per host
+second) and is invisible to both.
+
+Sweep rows ingest like bench rows: a ``graphite-tpu sweep -o`` output
+or a bench ``radix8_sweep8`` detail row carries ``variants`` +
+``host_seconds`` and lands with its per-variant detail in raw_json.
 
 Importable: ``open_db``, ``add_run``, ``query``, ``check_regression``.
 """
@@ -80,6 +87,25 @@ def _mips(row: dict):
     return m if m > 0 else None
 
 
+def variants_per_sec(row: dict):
+    """Sweep throughput of an ingested row: completed config variants
+    over host seconds (bench radix8_sweep8 rows and `graphite-tpu sweep`
+    outputs carry the ratio directly; otherwise it derives from
+    ``variants`` + ``host_seconds``).  None for non-sweep rows."""
+    v = row.get("variants_per_sec")
+    if v is not None:
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+    n = row.get("variants")
+    host_s = row.get("host_seconds")
+    if not n or not host_s:
+        return None
+    return float(n) / float(host_s)
+
+
 def check_regression(db: sqlite3.Connection, workload: str, row: dict,
                      threshold_pct: float = REGRESSION_PCT):
     """Compare ``row``'s rounds/s AND simulated MIPS against the most
@@ -91,7 +117,8 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
     most recent prior row that HAS that metric, so a probe row without
     MIPS doesn't break the MIPS chain.  Call BEFORE add_run so the
     comparison point is genuinely prior."""
-    metrics = (("rounds/s", rounds_per_sec), ("MIPS", _mips))
+    metrics = (("rounds/s", rounds_per_sec), ("MIPS", _mips),
+               ("variants/s", variants_per_sec))
     warnings = []
     for name, fn in metrics:
         new = fn(row)
@@ -165,10 +192,21 @@ def main(argv) -> int:
                 print(warn)
 
         if "detail" in data:
+            n = 0
             for name, row in data["detail"].items():
                 if isinstance(row, dict):
                     _add(name, row)
-            print(f"added {len(data['detail'])} rows")
+                    n += 1
+            # A sweep result (graphite-tpu sweep -o / cli sweep line)
+            # ALSO carries batch-level throughput on the top object —
+            # ingest it as its own workload so the variants/s regression
+            # chain has a row to compare against.
+            if variants_per_sec(data) is not None:
+                top = {k: v for k, v in data.items() if k != "detail"}
+                _add(data.get("workload") or data.get("metric") or "sweep",
+                     top)
+                n += 1
+            print(f"added {n} rows")
         else:
             _add(data.get("workload") or "run", data)
             print("added 1 row")

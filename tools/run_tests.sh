@@ -127,6 +127,48 @@ if ! timeout 60 python bench.py --help > /dev/null 2>&1; then
     fail=1
 fi
 
+# Sweep smoke gate (ISSUE 7 CI satellite): a two-variant tiny sweep must
+# run through the driver with EXACTLY ONE XLA compile for the bucket
+# (batch.compile_count() counts jit traces == in-process compile
+# requests; variant values leaking into the static argument would show
+# as a second trace), and each lane must match its solo run bit-exactly.
+sweep_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.sweep import SweepDriver, build_variants
+from graphite_tpu.sweep import batch as batchmod
+
+cfg = load_config()
+cfg.set("general/total_cores", 2)
+trace = synth.gen_radix(2, keys_per_tile=16, radix=8)
+variants = build_variants(cfg, ["dram/latency=80,140"])
+before = batchmod.compile_count()
+drv = SweepDriver(trace)
+tickets = [drv.submit(p) for _, _, p in variants]
+results = drv.drain()
+compiles = batchmod.compile_count() - before
+assert compiles == 1, f"bucket compiled {compiles} programs, expected 1"
+for (label, _, p), t in zip(variants, tickets):
+    lane, solo = results[t], Simulator(p, trace).run()
+    assert np.array_equal(lane.clock, solo.clock), label
+    for k in lane.counters:
+        assert np.array_equal(lane.counters[k], solo.counters[k]), \
+            f"{label}.{k}"
+print(f"SWEEP SMOKE OK ({compiles} compile, "
+      f"{len(tickets)} variants bit-identical to serial)")
+PYEOF
+)
+sweep_rc=$?
+echo "$sweep_out" | tail -3
+if [ $sweep_rc -ne 0 ]; then
+    echo "SWEEP SMOKE GATE FAILED"
+    fail=1
+fi
+
 # Chain-oracle gate (ISSUE 6): the blocking-semantics miss-chain engine
 # must match the one-parked-request oracle within 2% — these equality
 # tests were xfail documentation of the round-4 MSHR machine's
