@@ -172,6 +172,14 @@ def _run(trace_fn, num_tiles: int, max_steps=None, label=None, **overrides):
         "host_s_per_Mcycle": round(
             host_s / max(d["completion_time_ns"] * 2.0 / 1e6, 1e-9), 3),
     }
+    if params.miss_chain > 0:
+        # Round-9 fan-out occupancy: chain heads served in-pass by the
+        # batched invalidation leg vs demoted to the round-loop fallback
+        # (PROFILE.md round-9 — the fallback share is the residual).
+        row["chain_fanout_served"] = int(
+            summary.counters["chain_fanout_served"].sum())
+        row["chain_fallback"] = int(
+            summary.counters["chain_fallback"].sum())
     report_path = _emit_row_telemetry(label, summary, row_spans)
     if report_path:
         row["telemetry"] = report_path
@@ -444,6 +452,31 @@ def main(argv=None) -> int:
     # Miss-chain A/B: the headline trace with chains on (ISSUE 6) —
     # runs FIRST so the round-count evidence survives any later timeout.
     safe("radix64_chain12", chain_ab)
+
+    def fanout_ab():
+        """Sharing-heavy fan-out A/B (ISSUE 9): a write-back fft64 trace
+        (alternating transpose direction — every transpose writes lines
+        the previous one left read-shared, the real fft.C signature)
+        under the chain replay, with the round-9 fan-out leg ON vs OFF
+        (``tpu/fanout_replay``).  rounds_vs_head8 is the round-count
+        ratio against the round-8 engine (fan-outs demoted to the
+        one-element-per-round fallback); chain_fanout_served /
+        chain_fallback report the in-pass fan-out occupancy."""
+        fft_wb = lambda T: synth.gen_fft(T, points_per_tile=64,
+                                         writeback=True)
+        row = _run(fft_wb, NUM_TILES, label="fft64",
+                   **{"tpu/miss_chain": 12})
+        off = _run(fft_wb, NUM_TILES, label="fft64_fanout_off",
+                   **{"tpu/miss_chain": 12, "tpu/fanout_replay": "false"})
+        row["rounds"] = row["engine_rounds"]
+        if row.get("engine_rounds") and off.get("engine_rounds"):
+            row["rounds_vs_head8"] = round(
+                off["engine_rounds"] / row["engine_rounds"], 2)
+        row["fanout_off_rounds"] = off.get("engine_rounds")
+        row["workload"] = "fft64 write-back transposes (sharing-heavy)"
+        return row
+
+    safe("fft64", fanout_ab)
 
     # Sweep-engine row (ISSUE 7): V=8 DRAM-latency variants of a radix8
     # trace as ONE vmapped device program — the design-space-exploration

@@ -25,7 +25,11 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 22  # v22: blocking-semantics miss chains — banked
+_SCHEMA_VERSION = 23  # v23: round-9 fan-out chain replay — carried
+#   window occupancy widens the win_* cache arrays to [.., 4K] (partial
+#   windows survive quantum cuts instead of forcing a refresh) and the
+#   chain_fanout_served / chain_fallback counters land in Counters;
+#   v22: blocking-semantics miss chains — banked
 #   elements no longer install at bank time, so the mq_victim array is
 #   gone (resolve fills at serve time and derives victims then);
 #   v21: quantum-scoped block-window cache arrays
@@ -101,11 +105,13 @@ def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:
                 raise ValueError(
                     f"checkpoint field {key!r} shape {a.shape} != expected "
                     f"{tmpl.shape} (params mismatch?)")
-            # Commit each leaf to a device array NOW: the engine's
-            # megarun/megastep donate their state argument, and donating
-            # a leaf that is still a host numpy view of the (mmap'd) npz
-            # is an aliasing hazard on the CPU backend (observed as
-            # nondeterministic wrong results / aborts in resumed runs).
-            leaves.append(jnp.asarray(a.astype(tmpl.dtype, copy=False)))
+            # Commit each leaf to a device array NOW, from an OWNED host
+            # copy: the engine's megarun/megastep donate their state
+            # argument, and donating a leaf that is still a host numpy
+            # view of the (mmap'd) npz is an aliasing hazard on the CPU
+            # backend (observed as nondeterministic wrong results /
+            # bitcast garbage in resumed runs).  jnp.array(copy=True) —
+            # not asarray, which zero-copies aligned host buffers.
+            leaves.append(jnp.array(a, dtype=tmpl.dtype, copy=True))
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     return state, steps

@@ -89,6 +89,21 @@ def _row_word(row: jnp.ndarray, way: jnp.ndarray) -> jnp.ndarray:
     return jnp.take_along_axis(row, way[None], axis=0)[0]
 
 
+def _spanned_bound(params: SimParams, vp, boundary):
+    """Round-9 boundary-spanning bound (``tpu/fanout_replay``, effective
+    only at miss_chain > 0): the window, complex-slot, and cadence gates
+    all admit ONE QUANTUM of overrun past the cut — the same allowance
+    mid-chain tiles already get via ``rel < qps``, the same skew class
+    the lax model absorbs (the 2% chain-oracle gate bounds it).  Strict
+    at miss_chain == 0 (that engine is the bit-identity oracle) and with
+    the replay off (the round-8 cadence)."""
+    if params.miss_chain > 0 and params.fanout_replay:
+        q = vp.quantum_ps if vp is not None \
+            else jnp.int64(params.quantum_ps)
+        return boundary + q
+    return boundary
+
+
 # ===================================================== block retirement
 
 def _window_slice_gather(st: SimState, trace: TraceArrays, width: int):
@@ -175,11 +190,16 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
 
     nm0 = st.mq_count if P > 0 else jnp.zeros(T, dtype=jnp.int32)
     in_chain = nm0 > 0
+    # Boundary-spanning windows (round 9, tpu/fanout_replay & P > 0):
+    # the quantum cut used to truncate every window mid-flight (~7 of 16
+    # slots retired per window round on the round-8 bench shape), so the
+    # empty-chain bound widens by one quantum of overrun.
+    wbound = _spanned_bound(params, vp, st.boundary)
     # Mid-chain tiles run on the relative clock: the boundary check moves
     # to the per-event prefix (rel < quantum bounds the overrun past the
     # unknown completion to one quantum of skew — the lax model's slack).
     tile_active = (~st.done) & (st.pend_kind == PEND_NONE) \
-        & (in_chain | (st.clock < st.boundary)) & (st.cursor < N)
+        & (in_chain | (st.clock < wbound)) & (st.cursor < N)
 
     # ---- window gather: next K events per tile.  With the
     # ThreadScheduler, each tile reads its SEATED stream's trace row.
@@ -305,6 +325,7 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
     # is what lets a chain run past the 8-16 sequential touches every
     # streamed line gets: without it the second touch of a just-banked
     # line would end every chain at depth ~1.
+    wfwd = P > 0 and params.fanout_replay
     if P > 0:
         same_line_w = line[:, :, None] == line[:, None, :]    # [T, Kj, Ki]
         fwd_win_d = (earlier & same_line_w & mem_bank0[:, None, :]
@@ -323,6 +344,24 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
         linematch_p = line[:, :, None] == pline.T[:, None, :]  # [T, K, P]
         cover_pd = linematch_p & pend_memT & is_rd[:, :, None]
         cover_pi = linematch_p & pend_ifT
+        if wfwd:
+            # Round-9: a WRITE whose line was EX-banked by an EARLIER
+            # event of this same window forwards as the post-fill M hit
+            # the blocking core sees — the EX serve grants M before the
+            # core reaches the second store, so radix-style streamed
+            # permute writes (8 stores per dest line) no longer end
+            # every chain at depth ~2.  In-window banks ONLY: covering
+            # writes against EX elements banked in EARLIER rounds left
+            # a whole sub-round for a concurrent steal to land (measured
+            # −2.23% on radix8, past the oracle gate; in-window-only is
+            # −0.42%).  A write over a pending SH still stalls (its
+            # upgrade is exactly what a concurrent EX steal takes away),
+            # and the fan-out replay serves the steal chains those
+            # upgrades become.
+            fwd_win_w = (earlier & same_line_w
+                         & (mem_bank0 & is_wr)[:, None, :]
+                         & is_wr[:, :, None]).any(axis=2)
+            fwd_win_d = fwd_win_d | fwd_win_w
         fwd_pend_d = jnp.any(cover_pd, axis=2)
         fwd_pend_i = jnp.any(cover_pi, axis=2)
         mem_fwd = mem_bank0 & (fwd_win_d | fwd_pend_d)
@@ -386,9 +425,10 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
     if P > 0:
         # Uncovered same-line use of an IN-WINDOW bank always stalls
         # (the no-duplicate-lines-per-chain invariant, window half).
+        bank_w_uncov = (mem_bank0 & ~is_wr) if wfwd else mem_bank0
         uncov_w = earlier & same_line_w & (
             (is_mem[:, :, None] & comp_bank0[:, None, :])
-            | (is_wr[:, :, None] & mem_bank0[:, None, :])
+            | (is_wr[:, :, None] & bank_w_uncov[:, None, :])
             | (is_comp[:, :, None] & mem_bank0[:, None, :]))
         hazard_uncov = uncov_w.any(axis=2)
         haz_d = haz_d | (is_mem & hazard_uncov)
@@ -409,6 +449,13 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
             (is_mem[:, :, None] & mem_bank0[:, None, :]
              & is_rd[:, :, None])
             | (is_comp[:, :, None] & comp_bank0[:, None, :]))
+        if wfwd:
+            # A write covered by an earlier in-window EX bank is the
+            # fill itself, never its victim — exempt from the L2-set
+            # hazard like the covered reads above.
+            l2_cover = l2_cover | (
+                same_line_w & is_wr[:, :, None]
+                & (mem_bank0 & is_wr)[:, None, :])
         hazard = hazard | ((is_mem | is_comp) & (
             earlier & l2ss & ~l2_cover
             & l2_fill_cand[:, None, :]).any(axis=2))
@@ -560,8 +607,9 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
             # the chain: once the bank is full, the tile stalls for the
             # resolve pass instead of retiring further hits against
             # going-stale probes (they cost the same rounds after the
-            # drain, re-probed against post-serve state).
-            in_b = jnp.where(nm == 0, clk < st.boundary,
+            # drain, re-probed against post-serve state).  Empty-chain
+            # tiles retire into the spanned bound (see wbound above).
+            in_b = jnp.where(nm == 0, clk < wbound,
                              (rel < qps) & (nm < P))
         else:
             bank_j = jnp.zeros(T, dtype=bool)
@@ -795,8 +843,13 @@ def _complex_slot(params: SimParams, vp: VariantParams, state: SimState,
     st = state
     c = st.counters
 
+    # Round-9: the complex slot spans like the window — a tile whose
+    # window ran past the cut and parked on a sync/atomic/lifecycle
+    # event retires it now instead of idling a whole quantum (sync
+    # costs are timestamp-based, so the early retire is skew-safe).
+    cbound = _spanned_bound(params, vp, st.boundary)
     active = (~st.done) & (st.pend_kind == PEND_NONE) \
-        & (st.clock < st.boundary) & (st.cursor < N)
+        & (st.clock < cbound) & (st.cursor < N)
     if params.miss_chain > 0:
         # Complex events need an absolute clock — a tile with banked
         # chain elements waits for the resolve pass to drain them.
@@ -1357,8 +1410,9 @@ def _complex_slot_guarded(params: SimParams, vp: VariantParams,
     if params.miss_chain <= 0:
         return _complex_slot(params, vp, state, trace)
     N = trace.num_events
+    gbound = _spanned_bound(params, vp, state.boundary)
     eligible = (~state.done) & (state.pend_kind == PEND_NONE) \
-        & (state.clock < state.boundary) & (state.cursor < N) \
+        & (state.clock < gbound) & (state.cursor < N) \
         & (state.mq_count == 0)
     # The window phase retires (or banks) every simple-class event, so
     # the general slot is needed only when an ELIGIBLE tile's next event
@@ -1437,28 +1491,52 @@ def local_advance(params: SimParams, state: SimState,
             def wprog(st):
                 return jnp.sum(st.cursor.astype(jnp.int64))
 
+            def _can_retire(st):
+                # A tile can use another window round iff it is live,
+                # un-parked, not at stream end, and either mid-chain
+                # with bank room + overrun credit left, or empty-chain
+                # inside the (possibly spanned) boundary.  Elementwise
+                # [T] — far cheaper than the probe round it replaces.
+                mid_ = st.mq_count > 0
+                wb_ = _spanned_bound(params, vp, st.boundary)
+                return (~st.done) & (st.pend_kind == PEND_NONE) \
+                    & (st.cursor < N) \
+                    & jnp.where(mid_,
+                                (st.chain_rel < qps)
+                                & (st.mq_count < params.miss_chain),
+                                st.clock < wb_)
+
+            # Round-9 adaptive skip (fanout_replay): the carried
+            # (progress, anyone-can-still-retire) pair ends the
+            # scheduled window rounds the moment every active tile is
+            # mid-chain and saturated — the round-8 loop burned a whole
+            # probe round to discover the same thing.  With the replay
+            # off, ``more`` is pinned True and the loop is the round-8
+            # progress-only form, bit-exactly.
+            if params.fanout_replay:
+                def wmore(s):
+                    return _can_retire(s).any()
+            else:
+                def wmore(s):
+                    return jnp.asarray(True)
+
             def wcond(c):
-                j, pv, cv, _s = c
-                return (j < cap_w) & ((j == 0) | (cv > pv))
+                j, pv, cv, more, _s = c
+                return (j < cap_w) & ((j == 0) | ((cv > pv) & more))
 
             def wbody(c):
-                j, _pv, cv, s = c
+                j, _pv, cv, _more, s = c
                 s = _block_retire(params, vp, s, trace)
-                return j + 1, cv, wprog(s), s
+                return j + 1, cv, wprog(s), wmore(s), s
 
             def wloop(st):
-                _, _, _, out = jax.lax.while_loop(
+                _, _, _, _, out = jax.lax.while_loop(
                     wcond, wbody,
-                    (jnp.int32(0), jnp.int64(-1), wprog(st), st))
+                    (jnp.int32(0), jnp.int64(-1), wprog(st),
+                     jnp.asarray(True), st))
                 return out
 
-            mid = state.mq_count > 0
-            can_retire = (~state.done) & (state.pend_kind == PEND_NONE) \
-                & (state.cursor < N) \
-                & jnp.where(mid,
-                            (state.chain_rel < qps)
-                            & (state.mq_count < params.miss_chain),
-                            state.clock < state.boundary)
+            can_retire = _can_retire(state)
             state = jax.lax.cond(can_retire.any(), wloop,
                                  lambda s: s, state)
         return _complex_slot_guarded(params, vp, state, trace)

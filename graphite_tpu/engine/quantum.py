@@ -56,7 +56,19 @@ def next_boundary(params: SimParams, state: SimState,
                     | (state.pend_kind == PEND_JOIN)
                     | (state.pend_kind == PEND_START))
     runnable = ~state.done & ~sync_blocked
-    min_clock = jnp.min(jnp.where(runnable, state.clock, TIME_MAX))
+    clk = state.clock
+    if params.miss_chain > 0 and params.fanout_replay:
+        # A mid-chain tile's seat clock is FROZEN at its pre-bank value
+        # until the drain restores it; its served progress lives in
+        # chain_base (the last served element's completion).  Taking the
+        # frozen clock pinned the barrier a whole chain-service span
+        # behind the machine's real time — empty-ish quanta whose rounds
+        # the budget pays for.  chain_base is a sound lower bound on the
+        # tile's post-drain clock, so the boundary may advance past it
+        # (round 9; off with fanout_replay=0 — the round-8 cadence).
+        clk = jnp.where(state.mq_head > 0,
+                        jnp.maximum(clk, state.chain_base), clk)
+    min_clock = jnp.min(jnp.where(runnable, clk, TIME_MAX))
     q = vp.quantum_ps if vp is not None else jnp.int64(params.quantum_ps)
     nb = (min_clock // q + 1) * q
     return jnp.where(runnable.any(), nb,

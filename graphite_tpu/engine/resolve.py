@@ -263,6 +263,21 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
     shared_l2 = params.shared_l2
     head0 = state.mq_head
     stop_hi = state.mq_count
+    # Round-9 batched invalidation leg (tpu/fanout_replay): multi-sharer
+    # EX/upgrade heads serve IN-PASS — the sharer bitmap expands to the
+    # per-sharer INV target mask and the fan-out send + ack-combining is
+    # priced with the round loop's exact math (max-hop unicast over the
+    # mask — the ATAC hub broadcast leg via noc_atac behind
+    # max_hop_to_mask_ps — doubled for the round trip, plus the
+    # directory's ack-combining cycles), budgeted at KF deliveries per
+    # replay iteration in FCFS order; budget losers RETRY the next
+    # iteration like election losers instead of demoting the chain tail
+    # to the one-element-per-round fallback.  LimitLESS software traps
+    # never reach here (the fast pass is full_map-only), and live
+    # directory victims still fall back — exactly the trap-only slow
+    # path LimitLESS argues for.
+    fanout = params.fanout_replay
+    KF = min(params.max_inv_fanout_per_round, T)
 
     # ---- per-tile constants of the pass (clock periods only change in
     # a complex slot, never mid-resolve)
@@ -272,6 +287,8 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
     p_l2 = _period(state, DVFSModule.L2_CACHE)
     p_l1d = _period(state, DVFSModule.L1_DCACHE)
     p_l1i = _period(state, DVFSModule.L1_ICACHE)
+    p_core = _period(state, DVFSModule.CORE)
+    ack_ps = _lat(vp.inv_ack_cycles, p_core)
     dram_access_ps = vp.dram_latency_ps
     dram_service_ps = vp.dram_processing_ps
     flits_req = noc.num_flits(CTRL_BYTES, vp.net_memory.flit_width_bits)
@@ -364,7 +381,21 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
         vic_dead = (way_state == I) \
             | (((way_state == S) | (way_state == O))
                & (entry_row == jnp.uint64(0)).all(axis=1))
-        cand = active & wslot & ~has_inv & (hit | (can_alloc & vic_dead))
+        cand0 = active & wslot & (hit | (can_alloc & vic_dead))
+        if fanout:
+            # Fan-out heads join the serve set through a KF-per-iteration
+            # FCFS budget (the round loop's fan-out budget semantics, per
+            # replay iteration); a budget loser keeps its chain alive and
+            # retries next iteration.
+            need_fan = cand0 & has_inv
+            fan_rank = jnp.sum(
+                (packed[None, :] < packed[:, None]) & need_fan[None, :]
+                & need_fan[:, None], axis=1, dtype=jnp.int32)
+            fan_sel = need_fan & (fan_rank < KF)
+            cand = cand0 & (~has_inv | fan_sel)
+        else:
+            fan_rank = jnp.zeros(T, dtype=jnp.int32)
+            cand = cand0 & ~has_inv
         # Owner flush/downgrade legs serve here with the round loop's
         # J_OWN per-target delivery budget (several requesters may name
         # one owner tile); over-budget rows stop their chain instead.
@@ -372,6 +403,7 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
         posr = _grouped_rank(owner, packed, cand & act.owner_leg)
         serve = cand & ~(act.owner_leg & (posr >= J_OWN))
         owner_leg = act.owner_leg & serve
+        fan_go = serve & has_inv          # in-pass fan-out serves
         evicting = serve & ~hit & (way_state != I)
 
         # ---- SH combining within the slot (the round loop's combining,
@@ -397,11 +429,13 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
         way = jnp.where(member, rep_way_t[hidx], way)
         serve_all = serve | member
         # Only transitions needing the round loop's machinery STOP a
-        # chain (invalidation fan-out, live directory victims, owner
-        # delivery-budget overflow); a plain way/line election loss
-        # retries at the next iteration.
+        # chain (live directory victims, owner delivery-budget overflow
+        # — and invalidation fan-outs only with tpu/fanout_replay off);
+        # a plain way/line election loss, or a fan-out budget loss with
+        # the replay leg on, retries at the next iteration.
+        stop_inv = has_inv if not fanout else jnp.zeros_like(has_inv)
         hard_stop = active & ~serve_all \
-            & (has_inv | (can_alloc & ~vic_dead) | (~hit & ~can_alloc)
+            & (stop_inv | (can_alloc & ~vic_dead) | (~hit & ~can_alloc)
                | (act.owner_leg & (posr >= J_OWN)))
         stopped = stopped | hard_stop
 
@@ -443,6 +477,41 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
                              params.line_size + CTRL_BYTES, p_net_own,
                              params.mesh_width, vnet=vp.net_memory)
         owner_ps = jnp.where(owner_leg, leg_ps, 0)
+        if fanout:
+            # Slot-assign the elected fan-outs ([KF, T]; budget ranks are
+            # unique among the selected rows) and expand each head's
+            # sharer bitmap to its per-sharer INV target mask.  The
+            # round trip is priced as a max-plus reduction over the
+            # sharers — the farthest unicast send + its ack, via the
+            # same noc dispatch the round loop uses (unicast-per-sharer
+            # hop math for directory-based nets, the hub broadcast leg
+            # for ATAC) — plus the directory's ack-combining cycles.
+            oh_fr = fan_go[None, :] & (
+                jnp.arange(KF, dtype=jnp.int32)[:, None]
+                == jnp.minimum(fan_rank, KF - 1)[None, :])
+
+            def fr_sel(vals):
+                return jnp.sum(jnp.where(oh_fr, vals[None, :], 0), axis=1,
+                               dtype=vals.dtype)
+
+            inv_words = jnp.sum(
+                jnp.where(oh_fr[:, :, None], act.inv_targets[None, :, :],
+                          jnp.uint64(0)), axis=1, dtype=jnp.uint64)
+            inv_bool = dirmod.bitmap_to_bool(inv_words, T)      # [KF, T]
+            home_fr = fr_sel(home)
+            pnh_fr = fr_sel(p_net[home].astype(jnp.int64)).astype(jnp.int32)
+            inv_ps_k = 2 * noc.max_hop_to_mask_ps(
+                params.net_memory, home_fr, inv_bool, CTRL_BYTES,
+                pnh_fr, params.mesh_width, vnet=vp.net_memory) \
+                + fr_sel(ack_ps)
+            inv_ps = jnp.where(fan_go, jnp.sum(
+                jnp.where(oh_fr, inv_ps_k[:, None], 0), axis=0), 0)
+            line_fr = fr_sel(line)
+            kcnt = jnp.sum(inv_bool, axis=1).astype(jnp.int64)  # [KF]
+            inv_count = jnp.where(fan_go, jnp.sum(
+                jnp.where(oh_fr, kcnt[:, None], 0), axis=0), 0)
+        else:
+            inv_count = jnp.zeros(T, dtype=jnp.int64)
         need_read = serve_all & act.dram_read
         if shared_l2:
             dsite = dram_site_of_line(params, line)
@@ -460,6 +529,11 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
         dram_arrival = t_dir + owner_ps + to_dram_ps
         dram_wb = act.dram_write & serve_all
         if params.dram.queue_model_enabled:
+            # record_split: a chain iteration's batch mixes tiles at
+            # very different chain depths, i.e. very different simulated
+            # times — split busy-interval records stop one tile's
+            # far-future element from convoying another tile's whole
+            # chain (fcfs_ring's phantom-convoy note).
             q_start, _, _, rs_, re_, rp_, mg1_ = queue_models.probe(
                 params.dram.queue_model_type,
                 dsite, dram_arrival, jnp.full(T, dram_service_ps),
@@ -467,7 +541,8 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
                 state.dram_ring_ptr, state.dram_qacc,
                 occ_res=dsite, occ_arr=dram_arrival,
                 occ_svc=jnp.full(T, dram_service_ps), occ_valid=dram_wb,
-                ma_window=params.dram.basic_ma_window)
+                ma_window=params.dram.basic_ma_window,
+                record_split=2 if fanout else 1)
             state = state._replace(dram_ring_start=rs_, dram_ring_end=re_,
                                    dram_ring_ptr=rp_, dram_qacc=mg1_)
             dram_start = jnp.where(need_read, q_start, 0)
@@ -477,6 +552,10 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
             + from_dram_ps
         t_data = jnp.maximum(t_dir + owner_ps,
                              jnp.where(need_read, dram_ready, 0))
+        if fanout:
+            # The data grant waits on the last invalidation ack — the
+            # round loop's exact completion rule.
+            t_data = jnp.maximum(t_data, t_dir + inv_ps)
         reply_done = t_data + reply_ps
         l1_fill_ps = jnp.where(
             is_if, _lat(vp.l1i_access_cycles, p_l1i),
@@ -530,11 +609,24 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
             ow_tgt, ow_slot].set(True, mode="drop")
         own_down = jnp.zeros((T, J_OWN), dtype=jnp.int32).at[
             ow_tgt, ow_slot].set(act.owner_downgrade_to, mode="drop")
+        if fanout:
+            # Fan-out INV deliveries ride the same per-target sweep: the
+            # [KF] served lines broadcast to every tile, masked by each
+            # slot's sharer bitmap column — one invalidate pass per
+            # cache covers owner downgrades AND sharer invalidations.
+            dlv_lines = jnp.concatenate(
+                [own_lines, jnp.broadcast_to(line_fr[None, :], (T, KF))],
+                axis=1)
+            dlv_valid = jnp.concatenate([own_valid, inv_bool.T], axis=1)
+            dlv_down = jnp.concatenate(
+                [own_down, jnp.full((T, KF), I, dtype=jnp.int32)], axis=1)
+        else:
+            dlv_lines, dlv_valid, dlv_down = own_lines, own_valid, own_down
         state = state._replace(
             l2=cachemod.invalidate_by_value(
-                state.l2, own_lines, own_valid, own_down),
+                state.l2, dlv_lines, dlv_valid, dlv_down),
             l1d=cachemod.invalidate_by_value(
-                state.l1d, own_lines, own_valid, own_down))
+                state.l1d, dlv_lines, dlv_valid, dlv_down))
 
         # ---- requester-side fills at serve time (the round loop's
         # winner path) + victim notify / DRAM writeback occupancy
@@ -623,12 +715,13 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
                     jnp.where(m_shar, rows, T), fslot].set(
                     0, mode="drop"))
             # Record coherence take-aways (the round loop's inv_dlv
-            # rule): owner-downgrade deliveries that drop the target's
-            # copy to I mark the TARGET tile's filter for the delivered
-            # line, so its re-miss classifies as sharing, not
+            # rule): deliveries that drop the target's copy to I —
+            # owner downgrades AND the fan-out leg's sharer
+            # invalidations — mark the TARGET tile's filter for the
+            # delivered line, so its re-miss classifies as sharing, not
             # cold/capacity.
-            inv_dlv = own_valid & (own_down == I)
-            dlv_line = own_lines
+            inv_dlv = dlv_valid & (dlv_down == I)
+            dlv_line = dlv_lines
             dslot = (dense.fmix64(dlv_line)
                      % jnp.uint64(HF)).astype(jnp.int32)
             tgt_rows = jnp.where(
@@ -644,8 +737,10 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
             b(evicting),                          # dir_evictions
             b(owner_leg),                         # dir_writebacks
             b(owner_leg & ~act.dram_write),       # dir_forwards
-            b(serve_all),                         # net_mem_pkts @home
-            jnp.where(serve_all, flits_data, 0),  # net_mem_flits @home
+            b(serve_all) + inv_count,             # net_mem_pkts @home
+            jnp.where(serve_all, flits_data, 0)
+            + inv_count * flits_req,              # net_mem_flits @home
+            inv_count,                            # dir_invalidations
         ]
         if shared_l2:
             home_cols += [b(serve_all), b(serve_all & ~hit)]  # l2_access/miss
@@ -661,7 +756,7 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
         hb = jnp.zeros((T, hstack.shape[1]), dtype=jnp.int64).at[
             home].add(hstack)
         if not shared_l2:
-            db = hb[:, 7:9]
+            db = hb[:, 8:10]
         c = state.counters
         c = c._replace(
             dir_sh_req=c.dir_sh_req + hb[:, 0],
@@ -669,16 +764,22 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
             dir_evictions=c.dir_evictions + hb[:, 2],
             dir_writebacks=c.dir_writebacks + hb[:, 3],
             dir_forwards=c.dir_forwards + hb[:, 4],
+            dir_invalidations=c.dir_invalidations + hb[:, 7],
             dram_reads=c.dram_reads + db[:, 0],
             dram_writes=c.dram_writes + db[:, 1] + vic_wr,
-            l2_access=c.l2_access + (hb[:, 7] if shared_l2 else 0),
-            l2_miss=c.l2_miss + (hb[:, 8] if shared_l2 else 0),
+            l2_access=c.l2_access + (hb[:, 8] if shared_l2 else 0),
+            l2_miss=c.l2_miss + (hb[:, 9] if shared_l2 else 0),
             net_mem_pkts=c.net_mem_pkts + b(serve_all) + b(victim_dirty)
             + hb[:, 5],
             net_mem_flits=c.net_mem_flits + b(serve_all) * flits_req
             + b(victim_dirty) * flits_data + hb[:, 6],
             mem_stall_ps=c.mem_stall_ps + jnp.where(
                 serve_all, completion - issue, 0),
+            # Round-9 occupancy: fan-outs served in-pass vs chain heads
+            # that hard-stopped into the round-loop fallback (the
+            # PROFILE.md round-9 table's two columns).
+            chain_fanout_served=c.chain_fanout_served + b(fan_go),
+            chain_fallback=c.chain_fallback + b(hard_stop),
         )
         state = state._replace(counters=c)
 
@@ -758,6 +859,9 @@ def resolve_memory(params: SimParams, vp: VariantParams,
     p_l1 = _period(state, DVFSModule.L1_DCACHE)
     p_core = _period(state, DVFSModule.CORE)
     cycle_ps = _lat(1, p_core)
+    # Invalidation-round ack-combining cost (directory.inv_ack_cycles,
+    # VARIANT operand; default 1 == the historical one-cycle charge).
+    ack_ps = _lat(vp.inv_ack_cycles, p_core)
 
     dram_access_ps = vp.dram_latency_ps
     dram_service_ps = vp.dram_processing_ps
@@ -1169,15 +1273,16 @@ def resolve_memory(params: SimParams, vp: VariantParams,
 
         home_sr = sr_sel(home)
         pnh_sr = sr_sel(p_net_home.astype(jnp.int64)).astype(jnp.int32)
-        cyc_sr = sr_sel(cycle_ps)
+        ack_sr = sr_sel(ack_ps)
 
-        # Invalidation round-trip latencies, mapped back per requester.
+        # Invalidation round-trip latencies, mapped back per requester
+        # (ack-combining cycles on top of the max-hop round trip).
         inv_ps_k = 2 * noc.max_hop_to_mask_ps(
             params.net_memory, home_sr, inv_bool, CTRL_BYTES,
-            pnh_sr, params.mesh_width, vnet=vp.net_memory) + cyc_sr
+            pnh_sr, params.mesh_width, vnet=vp.net_memory) + ack_sr
         vic_ps_k = 2 * noc.max_hop_to_mask_ps(
             params.net_memory, home_sr, vic_bool, CTRL_BYTES,
-            pnh_sr, params.mesh_width, vnet=vp.net_memory) + cyc_sr
+            pnh_sr, params.mesh_width, vnet=vp.net_memory) + ack_sr
         inv_ps = jnp.where(has_inv, jnp.sum(
             jnp.where(oh_sr, inv_ps_k[:, None], 0), axis=0), 0)
         evict_ps = jnp.where(evict_s, jnp.sum(

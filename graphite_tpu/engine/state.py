@@ -103,6 +103,12 @@ class Counters(NamedTuple):
     l2_miss_sharing: jnp.ndarray     #   coherence-invalidated
     mem_stall_ps: jnp.ndarray        # time blocked on remote memory
     sync_stall_ps: jnp.ndarray       # time blocked on sync/recv
+    chain_fanout_served: jnp.ndarray  # invalidation fan-out heads served
+    #   INSIDE the chain replay (round 9's batched INV leg; 0 with
+    #   tpu/fanout_replay off or miss_chain 0)
+    chain_fallback: jnp.ndarray      # chain heads that hard-stopped out
+    #   of the replay into the one-element-per-round fallback — the
+    #   fallback-occupancy counter PROFILE.md's round-9 table reads
 
 
 def make_counters(num_tiles: int) -> Counters:
@@ -240,7 +246,8 @@ class SimState(NamedTuple):
     # slice from the full device trace EVERY round; miss-dominated traces
     # retire ~1.4 events/tile/round, so ~90% of that HBM traffic re-read
     # bytes fetched the round before (PROFILE.md lever 2).  Instead a
-    # [T, WC] slice (WC = 2K) is gathered once and advances with the
+    # [T, WC] slice (WC = 4K; 2K before round 9's boundary-spanning
+    # windows raised per-round consumption) is gathered once and advances with the
     # cursor: rounds read from this small resident cache, and a full
     # re-gather happens only when some ACTIVE tile's next-K events fall
     # outside its cached span (or its seat rotated) — a guarded lax.cond,
@@ -540,12 +547,19 @@ WIN_BASE_INVALID = -(1 << 30)   # win_base sentinel: forces a refresh
 
 
 def _win_cache_width(params: SimParams) -> int:
-    """Cached block-window width: 2x the [T, K] window, so a tile
-    retiring its full window still serves the NEXT round from cache
-    before a refresh is due.  0 disables (no cache arrays, per-round
-    trace gathers — the pre-cache engine shape)."""
+    """Cached block-window width: 4x the [T, K] window (round 9; was 2x),
+    so partial window occupancy carries across sub-rounds and quantum
+    cuts — with boundary-spanning windows a tile retires up to K slots
+    per round instead of ~7, and a 2K cache forced the guarded full-trace
+    refresh nearly every round; at 4K a tile consumes its resident span
+    over ~3 full windows before a refresh is due, whatever the boundary
+    did to the rounds in between.  Values stay bit-identical to direct
+    gathers by construction (same clamped indices), so the width is pure
+    cache geometry (checkpoint schema v23 carries the wider arrays).
+    0 disables (no cache arrays, per-round trace gathers — the pre-cache
+    engine shape)."""
     if params.window_cache and params.block_events > 0:
-        return 2 * params.block_events
+        return 4 * params.block_events
     return 0
 DRAM_RING_SLOTS = 8  # busy-interval history per memory controller
 MISS_FILTER_SLOTS = 1 << 14   # per-tile miss-type filter entries (2x the

@@ -87,6 +87,9 @@ class VariantParams(NamedTuple):
     # Directory.
     dir_access_cycles: jnp.ndarray        # int32
     limitless_trap_cycles: jnp.ndarray    # int32
+    inv_ack_cycles: jnp.ndarray           # int32 — invalidation-round
+    #   ack-combining cost (round loop AND the chain replay's batched
+    #   fan-out leg price it identically, so a sweep over it moves both)
     # DRAM (ps; bandwidth -> serialization pre-derived per line).
     dram_latency_ps: jnp.ndarray          # int64
     dram_processing_ps: jnp.ndarray       # int64 per cache line
@@ -117,6 +120,7 @@ def variant_params(params: SimParams) -> VariantParams:
         l2_tags_access_cycles=i32(params.l2.tags_access_cycles),
         dir_access_cycles=i32(params.directory.access_cycles),
         limitless_trap_cycles=i32(params.directory.limitless_trap_cycles),
+        inv_ack_cycles=i32(params.directory.inv_ack_cycles),
         dram_latency_ps=i64(params.dram.latency_ps),
         dram_processing_ps=i64(
             params.dram.processing_ps_per_line(params.line_size)),

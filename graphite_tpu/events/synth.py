@@ -285,7 +285,7 @@ def gen_radix(num_tiles: int, keys_per_tile: int = 4096, radix: int = 256,
 
 
 def gen_fft(num_tiles: int, points_per_tile: int = 1024,
-            line_size: int = 64) -> Trace:
+            line_size: int = 64, writeback: bool = False) -> Trace:
     """Address-accurate SPLASH-2 FFT trace (reference:
     tests/benchmarks/fft/fft.C — the six-step 1D radix-sqrt(n) FFT).
 
@@ -296,6 +296,14 @@ def gen_fft(num_tiles: int, points_per_tile: int = 1024,
     block from EVERY other tile's partition and writes into its own,
     which is the communication signature FFT stresses at 256 tiles
     (BASELINE config 2).
+
+    ``writeback=True`` alternates the transpose DIRECTION (src -> dst,
+    then dst -> src, ...), as fft.C's ping-ponging x/trans arrays do:
+    each transpose then WRITES lines the previous one left read-shared
+    across up to line_size/16 tiles, so the trace carries the EX-on-
+    multi-sharer invalidation fan-outs of the real kernel.  Default
+    False preserves the historical one-directional trace bit-exactly
+    (the equality-gate fixtures are pinned to it).
     """
     tb = TraceBuilder(num_tiles, line_size=line_size)
     elem = 16                                  # complex double
@@ -306,32 +314,39 @@ def gen_fft(num_tiles: int, points_per_tile: int = 1024,
     blk = max(1, points_per_tile // max(1, num_tiles))
     log_n = max(1, (points_per_tile * num_tiles).bit_length() - 1)
 
-    def transpose(t, phase):
+    def transpose(t, phase, a_from=src, a_to=dst):
         for p in range(num_tiles):
             for i in range(blk):
-                a_src = src + p * part + (t * blk + i) * elem
-                a_dst = dst + t * part + (p * blk + i) * elem
+                a_src = a_from + p * part + (t * blk + i) * elem
+                a_dst = a_to + t * part + (p * blk + i) * elem
                 tb.compute(t, 2, 2)
                 tb.read(t, a_src, elem)
                 tb.write(t, a_dst, elem)
         tb.barrier(t, phase, num_tiles)
 
-    def local_fft(t, phase):
+    def local_fft(t, phase, base=dst):
         # 1D FFTs over the tile's own rows: ~5 log2(n) flops per point,
         # sequential read-modify-write sweep.
         for i in range(points_per_tile):
             tb.compute(t, 5 * log_n, 5 * log_n)
-            a = dst + t * part + i * elem
+            a = base + t * part + i * elem
             tb.read(t, a, elem)
             tb.write(t, a, elem)
         tb.barrier(t, phase, num_tiles)
 
     for t in range(num_tiles):
-        transpose(t, 0)
-        local_fft(t, 1)
-        transpose(t, 2)
-        local_fft(t, 3)
-        transpose(t, 4)
+        if writeback:
+            transpose(t, 0, src, dst)
+            local_fft(t, 1, dst)
+            transpose(t, 2, dst, src)
+            local_fft(t, 3, src)
+            transpose(t, 4, src, dst)
+        else:
+            transpose(t, 0)
+            local_fft(t, 1)
+            transpose(t, 2)
+            local_fft(t, 3)
+            transpose(t, 4)
     return tb.build()
 
 

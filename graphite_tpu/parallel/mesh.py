@@ -47,6 +47,10 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
 _TILE_AXIS_BY_FIELD = {
     "word": 1, "meta": 1,            # CacheArrays [A, T, sets] / trace
     "win_meta": 1,                   # [3, T, WC] window-cache slice
+    #   (WC = 4K since the round-9 boundary-spanning windows; win_addr/
+    #   win_base/win_seat and the round-9 chain_fanout_served/
+    #   chain_fallback counters are tile-leading, covered by the
+    #   default axis-0 rule below)
     "dir_word": 1,                   # [A, T*dsets] (tile-major flat)
     "dir_sharers": 1,                # [W*A, T*dsets]
     "ch_time": 1,                    # [D, T, T]

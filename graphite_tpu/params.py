@@ -167,6 +167,12 @@ class DirectoryParams:
     directory_type: str     # full_map | limited_broadcast | limited_no_broadcast | ackwise | limitless
     access_cycles: int
     limitless_trap_cycles: int
+    # Ack-combining cost (cycles) the directory pays per invalidation
+    # round: the INV round trip completes when the LAST sharer's ack has
+    # been folded in (reference dram_directory_cntlr counts acks and
+    # unblocks on the final one).  Default 1 keeps the pre-round-9
+    # math (one requester-core cycle on top of the max-hop round trip).
+    inv_ack_cycles: int = 1
 
     @property
     def num_sets(self) -> int:
@@ -198,6 +204,9 @@ class DirectoryParams:
             directory_type=cfg.get_str("dram_directory/directory_type"),
             access_cycles=access,
             limitless_trap_cycles=cfg.get_int("limitless/software_trap_penalty"),
+            inv_ack_cycles=_positive(
+                cfg.get_int("dram_directory/inv_ack_combining_cycles", 1),
+                "dram_directory/inv_ack_combining_cycles"),
         )
 
 
@@ -678,7 +687,7 @@ class SimParams:
     # the general one-event slot, the round-2 engine shape).
     block_events: int
     # Quantum-scoped block-window cache: gather the window's trace slice
-    # into resident [T, 2K] SimState arrays that advance with the cursor,
+    # into resident [T, 4K] SimState arrays that advance with the cursor,
     # instead of re-gathering [T, K] from the full device trace every
     # round (engine/core._block_retire; PROFILE.md lever 2).  Results are
     # bit-identical either way — false restores the per-round gather (the
@@ -709,6 +718,14 @@ class SimParams:
     # pass (the fan-out/live-victim fallback after the chain replay);
     # leftovers carry to the next sub-round's pass via mq_head.
     max_resolve_rounds: int
+    # Round-9 chain cadence (effective only with miss_chain > 0): serve
+    # invalidation fan-outs INSIDE the chain replay (batched per-sharer
+    # INV pricing instead of demoting the whole chain to the
+    # one-element-per-round fallback), let the block window span the
+    # quantum boundary by one quantum instead of truncating mid-window,
+    # and advance the barrier past served chain progress.  False
+    # restores the round-8 chain engine — the bench fft64 A/B switch.
+    fanout_replay: bool
     channel_depth: int
     # Captured-trace replay: a recorded COND_WAIT provably consumed SOME
     # signal in the native run, but simulated retiming can invert the
@@ -892,6 +909,9 @@ class SimParams:
                 access_cycles=l2.access_cycles,
                 limitless_trap_cycles=cfg.get_int(
                     "limitless/software_trap_penalty"),
+                inv_ack_cycles=_positive(
+                    cfg.get_int("dram_directory/inv_ack_combining_cycles", 1),
+                    "dram_directory/inv_ack_combining_cycles"),
             )
         else:
             directory = DirectoryParams.from_config(
@@ -974,6 +994,7 @@ class SimParams:
             max_resolve_rounds=_positive(
                 cfg.get_int("tpu/max_resolve_rounds", 4),
                 "tpu/max_resolve_rounds"),
+            fanout_replay=cfg.get_bool("tpu/fanout_replay", True),
             channel_depth=cfg.get_int("tpu/channel_depth", 16),
             cond_replay=cfg.get_bool("tpu/cond_replay", False),
         )

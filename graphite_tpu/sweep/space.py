@@ -61,6 +61,7 @@ VARIANT_LEAVES = frozenset({
     "l2.data_access_cycles", "l2.tags_access_cycles",
     # directory
     "directory.access_cycles", "directory.limitless_trap_cycles",
+    "directory.inv_ack_cycles",
     # DRAM
     "dram.latency_ns", "dram.per_controller_bandwidth_gbps",
     # NoCs (both logical networks)
@@ -214,7 +215,7 @@ def canonical_params(params: SimParams) -> SimParams:
         core=r(params.core, bp_mispredict_penalty=1),
         l1i=cache(params.l1i), l1d=cache(params.l1d), l2=cache(params.l2),
         directory=r(params.directory, access_cycles=1,
-                    limitless_trap_cycles=1),
+                    limitless_trap_cycles=1, inv_ack_cycles=1),
         dram=r(params.dram, latency_ns=1.0,
                per_controller_bandwidth_gbps=1.0),
         net_user=net(params.net_user),

@@ -134,7 +134,8 @@ def test_round_identity_window_cache(num_tiles):
     runs under) must leave the engine's ROUND STRUCTURE untouched — not
     just final timing.  With the cache off, _block_retire re-gathers its
     [T, K] slice from the trace every round (the seed engine's shape);
-    with it on, rounds read the resident [T, 2K] slice.  Both runs must
+    with it on, rounds read the resident [T, 4K] slice (2K before the
+    round-9 boundary-spanning windows).  Both runs must
     retire the same events in the same rounds: every phase-execution
     counter (quanta, window retirements, complex slots, resolve passes,
     conflict rounds) and the final per-tile clocks are bit-identical."""

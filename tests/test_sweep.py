@@ -221,3 +221,48 @@ def test_sweep_t64_shape():
     _assert_lane_equals_solo(lanes[0], solo, "T64 lane0")
     times = [lane.completion_time_ps for lane in lanes]
     assert times == sorted(times) and times[0] < times[-1]
+
+
+def test_fanout_leaves_classified():
+    """Round-9 leaves ride the partition correctly: the fan-out timing
+    constant is a VARIANT operand (engine/vparams threads it into both
+    the round loop and the chain replay's batched INV leg), while the
+    replay switch and the per-iteration fan-out budget are STRUCTURAL
+    (they select compiled code paths / loop shapes)."""
+    assert classify("directory.inv_ack_cycles", 1) == "variant"
+    assert "directory.inv_ack_cycles" in VARIANT_LEAVES
+    # bool switch: structural by nature (is_numeric_leaf rejects bools)
+    assert not is_numeric_leaf(True)
+    assert classify("fanout_replay", True) == "structural"
+    assert "max_inv_fanout_per_round" in STRUCTURAL_LEAVES
+
+
+def test_sweep_inv_ack_axis_bit_identical():
+    """One sweep axis over a fan-out constant
+    (dram_directory/inv_ack_combining_cycles) on a sharing-heavy
+    migratory trace under the chain replay: every lane bit-identical to
+    its solo run (the fan-out leg's ack-combining charge is the same
+    VARIANT operand either way), one compile for the bucket, and the
+    axis is LIVE (the ack cost reaches completion times)."""
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    cfg.set("tpu/miss_chain", 8)
+    trace = synth.gen_migratory(4, lines=8, rounds=4)
+    variants = build_variants(
+        cfg, ["dram_directory/inv_ack_combining_cycles=1,512,2048,4096"])
+    assert len(variants) == 4
+
+    before = batchmod.compile_count()
+    drv = SweepDriver(trace)
+    tickets = [drv.submit(p) for _, _, p in variants]
+    results = drv.drain()
+    assert batchmod.compile_count() - before == 1
+
+    clocks = []
+    for (label, _, p), t in zip(variants, tickets):
+        lane = results[t]
+        solo = Simulator(p, trace).run()
+        _assert_lane_equals_solo(lane, solo, label)
+        clocks.append(lane.completion_time_ps)
+    assert len(set(clocks)) > 1, \
+        "inv_ack_combining_cycles axis never reached a completion time"

@@ -16,15 +16,20 @@ Usage:
 
 ``add`` also flags engine-throughput regressions: each ingested row's
 rounds/s (bench ``engine_rounds`` or RunReport ``quanta`` over
-``host_seconds``), simulated MIPS, AND sweep variants/s (bench/cli
-sweep rows: ``variants`` over ``host_seconds``) are compared against
-the most recent prior run of the same workload, and a drop of more
-than 20% in any prints a ``REGRESSION`` line (exit code stays 0 — the
-flag is for CI greps and humans, not a gate).  Multiple metrics matter
-since the miss-chain engine trades rounds for heavier rounds: rounds/s
-alone would call that a regression, MIPS alone would hide a fixed-cost
-one; variants/s is the sweep engine's own unit (config points per host
-second) and is invisible to both.
+``host_seconds``), simulated MIPS, sweep variants/s (bench/cli
+sweep rows: ``variants`` over ``host_seconds``), AND events/round are
+compared against the most recent prior run of the same workload, and a
+drop of more than 20% in any prints a ``REGRESSION`` line (exit code
+stays 0 — the flag is for CI greps and humans, not a gate).  Multiple
+metrics matter since the miss-chain engine trades rounds for heavier
+rounds: rounds/s alone would call that a regression, MIPS alone would
+hide a fixed-cost one; variants/s is the sweep engine's own unit
+(config points per host second) and is invisible to both; events/round
+is the round-COUNT levers' metric (chain replay, fan-out leg) — a
+cadence regression is invisible to all three others on a CPU host,
+where per-round dispatch cost is ~free.  Each metric chains to the
+most recent prior row that HAS it, so probe/skipped rows can't mask a
+later regression.
 
 Sweep rows ingest like bench rows: a ``graphite-tpu sweep -o`` output
 or a bench ``radix8_sweep8`` detail row carries ``variants`` +
@@ -87,6 +92,28 @@ def _mips(row: dict):
     return m if m > 0 else None
 
 
+def events_per_round(row: dict):
+    """Events retired per engine round — the round-COUNT levers' metric
+    (miss-chain replay, round-9 fan-out leg): a cadence regression that
+    leaves wall-clock flat on CPU (rounds/s and MIPS blind to it) still
+    shows here.  Bench rows carry the ratio directly; otherwise it
+    derives from events_per_sec x host_seconds over engine_rounds.
+    None when not derivable."""
+    e = row.get("events_per_round")
+    if e is not None:
+        try:
+            e = float(e)
+        except (TypeError, ValueError):
+            return None
+        return e if e > 0 else None
+    rounds = row.get("engine_rounds")
+    eps = row.get("events_per_sec")
+    host_s = row.get("host_seconds")
+    if not rounds or not eps or not host_s:
+        return None
+    return float(eps) * float(host_s) / float(rounds)
+
+
 def variants_per_sec(row: dict):
     """Sweep throughput of an ingested row: completed config variants
     over host seconds (bench radix8_sweep8 rows and `graphite-tpu sweep`
@@ -118,7 +145,8 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
     MIPS doesn't break the MIPS chain.  Call BEFORE add_run so the
     comparison point is genuinely prior."""
     metrics = (("rounds/s", rounds_per_sec), ("MIPS", _mips),
-               ("variants/s", variants_per_sec))
+               ("variants/s", variants_per_sec),
+               ("events/round", events_per_round))
     warnings = []
     for name, fn in metrics:
         new = fn(row)

@@ -127,6 +127,46 @@ if ! timeout 60 python bench.py --help > /dev/null 2>&1; then
     fail=1
 fi
 
+# Round-count budget smoke (ISSUE 9 CI satellite): the radix-8 probe
+# trace must finish under a FIXED round ceiling.  Rounds are exact and
+# deterministic (no host noise), so the ceiling is a hard gate the way
+# the chain-oracle gate refuses xfails: the round-9 engine retires this
+# trace in 86 rounds, the round-8 cadence took 92, so a ceiling of 90
+# refuses any regression of the boundary-spanning/fan-out cadence —
+# including a silent flip of the tpu/fanout_replay default.
+budget_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+ROUND_CEILING = 90
+cfg = load_config()
+cfg.set("general/total_cores", 8)
+cfg.set("tpu/miss_chain", 12)
+params = SimParams.from_config(cfg)
+# Same shape as the chain-oracle equality gate -> persistent-cache hit.
+trace = synth.gen_radix(num_tiles=8, keys_per_tile=64, radix=16, seed=3)
+sim = Simulator(params, trace)
+s = sim.run(max_steps=512)
+assert s.done.all(), "round-budget trace did not complete"
+rounds = int(jax.device_get(sim.state.round_ctr))
+assert rounds <= ROUND_CEILING, (
+    f"ROUND BUDGET EXCEEDED: {rounds} > {ROUND_CEILING} (round-9 "
+    f"cadence retires this trace in 86; 92 is the round-8 engine)")
+print(f"ROUND BUDGET SMOKE OK ({rounds} rounds <= {ROUND_CEILING})")
+PYEOF
+)
+budget_rc=$?
+echo "$budget_out" | tail -3
+if [ $budget_rc -ne 0 ]; then
+    echo "ROUND BUDGET GATE FAILED"
+    fail=1
+fi
+
 # Sweep smoke gate (ISSUE 7 CI satellite): a two-variant tiny sweep must
 # run through the driver with EXACTLY ONE XLA compile for the bucket
 # (batch.compile_count() counts jit traces == in-process compile
@@ -195,13 +235,15 @@ elif echo "$chain_out" | grep -qE "xfailed|xpassed"; then
 else
     line=$(echo "$chain_out" | grep -E "passed|failed|error" | tail -1)
     echo "chain-oracle gate: $line"
-    # The quick tier holds 5 chain tests (2 equality gates + 3
-    # invariants); fewer passing means one was slow-marked/skipped out
+    # The quick tier holds 7 chain tests (2 equality gates + 3
+    # invariants + the migratory drift pin + the fan-out round-drop
+    # canary); fewer passing means one was slow-marked/skipped out
     # of the tier — deselection must be as loud as an xfail.
     npass=$(echo "$line" | grep -oE "^[0-9]+" | head -1)
-    if [ "${npass:-0}" -lt 5 ]; then
+    if [ "${npass:-0}" -lt 7 ]; then
         echo "CHAIN ORACLE GATE FAILED (only ${npass:-0} chain tests ran" \
-             "in this tier; the 2 equality gates + 3 invariants must all run)"
+             "in this tier; the 2 equality gates + 3 invariants + the 2" \
+             "round-9 canaries must all run)"
         fail=1
     fi
 fi
