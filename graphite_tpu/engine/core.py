@@ -42,6 +42,8 @@ import jax.numpy as jnp
 from graphite_tpu.engine import cache as cachemod
 from graphite_tpu.engine import dense
 from graphite_tpu.engine import noc
+from graphite_tpu.engine.kernels import dispatch as kdispatch
+from graphite_tpu.engine.kernels import window as kwindow
 from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
     PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
@@ -64,9 +66,13 @@ I, S, E, M = cachemod.I, cachemod.S, cachemod.E, cachemod.M
 STAMP_STRIDE = params_mod.STAMP_STRIDE
 
 
-def _lat(cycles, period_ps):
-    """cycles (int/array) at an integer ps clock period -> int64 ps."""
-    return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)
+# Shared with the window kernel (ONE definition — the kernels-on/off
+# bit-identity contract forbids the walk and the complex-slot/cadence
+# gates drifting apart): cycles->ps conversion, set-row way select, and
+# the round-9 boundary-spanning rule all live in kernels/window.py.
+_lat = kwindow._lat
+_row_word = kwindow._row_word
+_spanned_bound = kwindow._spanned_bound
 
 
 def _period(state: SimState, module: DVFSModule):
@@ -82,26 +88,6 @@ def mcp_tile(params: SimParams) -> int:
 
 def _stamp_base(st: SimState):
     return st.round_ctr * STAMP_STRIDE
-
-
-def _row_word(row: jnp.ndarray, way: jnp.ndarray) -> jnp.ndarray:
-    """[A, ...] gathered set row x [...] way -> [...] line word."""
-    return jnp.take_along_axis(row, way[None], axis=0)[0]
-
-
-def _spanned_bound(params: SimParams, vp, boundary):
-    """Round-9 boundary-spanning bound (``tpu/fanout_replay``, effective
-    only at miss_chain > 0): the window, complex-slot, and cadence gates
-    all admit ONE QUANTUM of overrun past the cut — the same allowance
-    mid-chain tiles already get via ``rel < qps``, the same skew class
-    the lax model absorbs (the 2% chain-oracle gate bounds it).  Strict
-    at miss_chain == 0 (that engine is the bit-identity oracle) and with
-    the replay off (the round-8 cadence)."""
-    if params.miss_chain > 0 and params.fanout_replay:
-        q = vp.quantum_ps if vp is not None \
-            else jnp.int64(params.quantum_ps)
-        return boundary + q
-    return boundary
 
 
 # ===================================================== block retirement
@@ -158,46 +144,34 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
                   trace: TraceArrays) -> SimState:
     """Retire the leading run of simple events in each tile's [K] window.
 
-    With ``tpu/miss_chain`` > 0 the window also executes PAST L2 misses
-    with BLOCKING semantics (the round-7 design; the round-4 optimistic-
-    install variant modeled a non-blocking MSHR machine and was retired —
-    see tests/test_chain_equivalence.py): the request is banked into the
-    tile's miss chain (mq_*; engine/state.py) with the local time since
-    the previous chain element recorded as its issue delta, the line is
-    NOT installed (the resolve pass installs it at serve time, against
-    the then-current directory state), and execution continues on a
-    RELATIVE clock.  Stall-on-use keeps the machine in-order: any later
-    window event that could observe a banked element's future fill — a
-    set-collision in the cache level the fill will land in, which
-    subsumes a touch of the missed line itself — stops the window until
-    the chain drains, exactly where the reference's blocking core would
-    still be stalled.  One resolve pass prices whole chains in FCFS
-    order — ~one device round per chain instead of one per miss.  Events
-    needing an absolute clock (STALL/SYNC floors, SPAWN, iocoom drains)
-    retire only on an empty chain.  In-order timing is exact: the core
-    stalls on each miss, so the continuation point of element k is its
-    completion, and later events' times are completion + accumulated
-    local dt.
+    This function is the gather/apply shell: it assembles the window
+    operands (trace slice via the window cache, cache arrays, chain
+    state), dispatches the WALK — probes, hit/stall/hazard
+    classification, branch-predictor RAW, the max-plus clock prefix,
+    chain banking, LRU touches/fills, counter accumulation — and lands
+    the results back into SimState.  The walk itself lives in
+    engine/kernels/window.py as ONE pure per-tile function shared by
+    both execution paths: inline lax (``tpu/pallas_kernels`` off — the
+    pre-round-10 program, op for op) or a single fused Pallas kernel
+    gridded over tile blocks (interpret / tpu modes), bit-identical by
+    construction.  See kernels/window.py for the walk semantics and the
+    round-7/9 blocking-chain commentary.
     """
     K = params.block_events
     T = params.num_tiles
     N = trace.num_events
     P = params.miss_chain
-    line_bits = params.line_size.bit_length() - 1
-    rows = jnp.arange(T)
     shared_l2 = params.shared_l2
-    mesi_local = params.protocol_kind == "sh_l2_mesi"
+    iocoom = params.core.model == "iocoom"
 
     nm0 = st.mq_count if P > 0 else jnp.zeros(T, dtype=jnp.int32)
     in_chain = nm0 > 0
     # Boundary-spanning windows (round 9, tpu/fanout_replay & P > 0):
-    # the quantum cut used to truncate every window mid-flight (~7 of 16
-    # slots retired per window round on the round-8 bench shape), so the
-    # empty-chain bound widens by one quantum of overrun.
+    # the quantum cut used to truncate every window mid-flight, so the
+    # empty-chain bound widens by one quantum of overrun.  Mid-chain
+    # tiles run on the relative clock: the boundary check moves to the
+    # per-event prefix inside the walk.
     wbound = _spanned_bound(params, vp, st.boundary)
-    # Mid-chain tiles run on the relative clock: the boundary check moves
-    # to the per-event prefix (rel < quantum bounds the overrun past the
-    # unknown completion to one quantum of skew — the lax model's slack).
     tile_active = (~st.done) & (st.pend_kind == PEND_NONE) \
         & (in_chain | (st.clock < wbound)) & (st.cursor < N)
 
@@ -220,610 +194,62 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
         addr = jnp.take_along_axis(st.win_addr, oidx, axis=1)
     else:
         meta, addr = _window_slice_gather(st, trace, K)
-    op, arg, arg2 = meta[0], meta[1], meta[2]
-    op = jnp.where(valid_ev, op, EventOp.NOP)
 
-    en = st.models_enabled            # scalar bool (flips are complex ops)
-
-    # ---- per-tile clock periods (DVFS-aware), ps per cycle
-    p_core = _period(st, DVFSModule.CORE)[:, None]
-    p_l1i = _period(st, DVFSModule.L1_ICACHE)[:, None]
-    p_l1d = _period(st, DVFSModule.L1_DCACHE)[:, None]
-    p_l2 = _period(st, DVFSModule.L2_CACHE)[:, None]
-    l1i_ps = _lat(vp.l1i_access_cycles, p_l1i)
-    l1d_ps = _lat(vp.l1d_access_cycles, p_l1d)
-    l2_ps = _lat(vp.l2_access_cycles, p_l2)
-    cycle_ps = _lat(1, p_core)
-
-    line = addr >> line_bits
-    is_comp = op == EventOp.COMPUTE
-    is_br = op == EventOp.BRANCH
-    is_rd = op == EventOp.MEM_READ
-    is_wr = op == EventOp.MEM_WRITE          # atomics stay complex
-    is_mem = is_rd | is_wr
-    is_stall = op == EventOp.STALL
-    is_sync = op == EventOp.SYNC
-    is_spawn = op == EventOp.SPAWN
-
-    # ---- probes against window-start state ([T, K] block gathers)
-    pI = cachemod.probe(st.l1i, line, params.l1i.num_sets)
-    pD = cachemod.probe(st.l1d, line, params.l1d.num_sets)
-    if not shared_l2:
-        pL2 = cachemod.probe(st.l2, line, params.l2.num_sets)
-
-    writable = pD.state >= (E if mesi_local else M)
-    l1_ok = pD.hit & (is_rd | writable)
-    if shared_l2:
-        mem_l2 = jnp.zeros_like(l1_ok)
-        comp_l2 = jnp.zeros_like(l1_ok)
-    else:
-        mem_l2 = is_mem & ~l1_ok & pL2.hit & (is_rd | (pL2.state == M))
-        comp_l2 = is_comp & ~pI.hit & pL2.hit
-    mem_simple = is_mem & (l1_ok | mem_l2)
-    comp_simple = is_comp & (pI.hit | comp_l2)
-    if params.core.model == "iocoom":
-        # Register-annotated events (scoreboard operands in arg2's high
-        # bits) need the complex slot's RAW floors/writes — decline them
-        # here.  Unannotated traces (arg2 high bits zero) are untouched.
-        # Heterogeneous model_list: only iocoom tiles decline (simple
-        # tiles ignore register annotations, as the reference's
-        # SimpleCoreModel does).
-        annotated = (is_comp & ((arg2 >> 20) != 0)) \
-            | (is_rd & (((arg2 >> 8) & 31) != 0))
-        if params.core.mixed:
-            annotated = annotated \
-                & jnp.asarray(params.core.iocoom_mask)[:, None]
-        mem_simple = mem_simple & ~annotated
-        comp_simple = comp_simple & ~annotated
-    fill_d = mem_l2                           # L1D fill from local L2 hit
-    fill_i = comp_l2                          # L1I fill from local L2 hit
-
-    # Bankable misses (miss both levels, or a write upgrade of a
-    # non-writable resident line) — retire by banking when chain slots
-    # remain.  Atomics stay complex (drain points).  Banking does NOT
-    # install the line (blocking semantics: the resolve pass fills at
-    # serve time); the hazard/forwarding rules below decide what may
-    # retire behind an outstanding bank.
-    if P > 0:
-        mem_bank0 = is_mem & ~l1_ok & ~mem_l2
-        comp_bank0 = is_comp & ~pI.hit & ~comp_l2
-    else:
-        mem_bank0 = jnp.zeros_like(l1_ok)
-        comp_bank0 = jnp.zeros_like(l1_ok)
-
-    # iocoom drain: branches are drain points without speculative loads —
-    # the drain floor (max outstanding LQ/SQ completion) is constant over
-    # the window (rings only change in resolve), so it folds into the
-    # max-plus clock transform below.
-    iocoom = params.core.model == "iocoom"
-    if iocoom:
-        drain_t = jnp.maximum(jnp.max(st.lq_ready, axis=0),
-                              jnp.max(st.sq_ready, axis=0))[:, None]
-        drain_ev = is_spawn | is_sync \
-            | (is_br if not params.core.speculative_loads
-               else jnp.zeros_like(is_br))
-        if params.core.mixed:
-            # Simple tiles have no LQ/SQ to drain (their rings stay 0,
-            # so drain_t is harmless, but the branch/sync drain
-            # semantics are iocoom-only).
-            drain_ev = drain_ev \
-                & jnp.asarray(params.core.iocoom_mask)[:, None]
-    else:
-        drain_ev = jnp.zeros_like(is_br)
-
-    ar = jnp.arange(K)
-    earlier = ar[None, :, None] > ar[None, None, :]           # [1, K, K]
-
-    # ---- chain forwarding (hit-on-pending-fill): a re-access of a line
-    # with an outstanding banked element retires as the post-fill HIT
-    # the blocking oracle sees (the fill completes before the core
-    # reaches the use), charged the plain L1 hit cost — for READS only:
-    # a write to a banked line always stalls for the drain and
-    # re-probes, because write ownership is exactly what a concurrent
-    # EX steal takes away (forwarding writes hid those steals and
-    # drifted completion well past the 2% oracle gate).  This
-    # is what lets a chain run past the 8-16 sequential touches every
-    # streamed line gets: without it the second touch of a just-banked
-    # line would end every chain at depth ~1.
-    wfwd = P > 0 and params.fanout_replay
-    if P > 0:
-        same_line_w = line[:, :, None] == line[:, None, :]    # [T, Kj, Ki]
-        fwd_win_d = (earlier & same_line_w & mem_bank0[:, None, :]
-                     & is_rd[:, :, None]).any(axis=2)
-        fwd_win_i = (earlier & same_line_w
-                     & comp_bank0[:, None, :]).any(axis=2)
-        # Pending elements banked in earlier rounds ([P, T] chain state).
-        slots_pc = jnp.arange(P, dtype=jnp.int32)[:, None]    # [P, 1]
-        pvalid = (slots_pc >= st.mq_head[None, :]) \
-            & (slots_pc < st.mq_count[None, :])               # [P, T]
-        pline = st.mq_req >> 8
-        pkind = (st.mq_req & 7).astype(jnp.int32)
-        p_is_if = pkind == PEND_IFETCH
-        pend_memT = (pvalid & ~p_is_if).T[:, None, :]         # [T, 1, P]
-        pend_ifT = (pvalid & p_is_if).T[:, None, :]
-        linematch_p = line[:, :, None] == pline.T[:, None, :]  # [T, K, P]
-        cover_pd = linematch_p & pend_memT & is_rd[:, :, None]
-        cover_pi = linematch_p & pend_ifT
-        if wfwd:
-            # Round-9: a WRITE whose line was EX-banked by an EARLIER
-            # event of this same window forwards as the post-fill M hit
-            # the blocking core sees — the EX serve grants M before the
-            # core reaches the second store, so radix-style streamed
-            # permute writes (8 stores per dest line) no longer end
-            # every chain at depth ~2.  In-window banks ONLY: covering
-            # writes against EX elements banked in EARLIER rounds left
-            # a whole sub-round for a concurrent steal to land (measured
-            # −2.23% on radix8, past the oracle gate; in-window-only is
-            # −0.42%).  A write over a pending SH still stalls (its
-            # upgrade is exactly what a concurrent EX steal takes away),
-            # and the fan-out replay serves the steal chains those
-            # upgrades become.
-            fwd_win_w = (earlier & same_line_w
-                         & (mem_bank0 & is_wr)[:, None, :]
-                         & is_wr[:, :, None]).any(axis=2)
-            fwd_win_d = fwd_win_d | fwd_win_w
-        fwd_pend_d = jnp.any(cover_pd, axis=2)
-        fwd_pend_i = jnp.any(cover_pi, axis=2)
-        mem_fwd = mem_bank0 & (fwd_win_d | fwd_pend_d)
-        comp_fwd = comp_bank0 & (fwd_win_i | fwd_pend_i)
-    else:
-        mem_fwd = comp_fwd = jnp.zeros_like(l1_ok)
-    mem_bank = mem_bank0 & ~mem_fwd
-    comp_bank = comp_bank0 & ~comp_fwd
-    mem_simple = mem_simple | mem_fwd
-    comp_simple = comp_simple | comp_fwd
-    fill_bank_d = mem_bank                    # future L1D fill (hazards)
-    fill_bank_i = comp_bank                   # future L1I fill
-
-    # ---- fill hazards: an event is unsafe once an earlier in-window fill
-    # (or, for a fill's own victim choice, any earlier same-set access)
-    # could have changed what its window-start probe saw.  One fill per
-    # tile per level per window keeps the fill apply path [T]-shaped.
-
-    def _hazard(fills, accesses, set_idx):
-        """accesses[j] unsafe if exists i<j with fills[i] & same set."""
-        same = set_idx[:, :, None] == set_idx[:, None, :]     # [T, Kj, Ki]
-        return accesses & (earlier & same & fills[:, None, :]).any(axis=2)
-
-    # hits stale after a same-set fill; a fill's victim choice stale after
-    # any same-set touch or fill.  (Multiple fills per window are fine as
-    # long as they land in distinct sets — the scatter below can't
-    # collide and victim picks from window-start stamps stay exact.)
-    # A MESI silent E->M upgrade also invalidates later probes of its set
-    # (a later same-line access would carry a stale E word into the
-    # touch scatter-max and win on stamp, losing the upgrade).
-    touch_d = is_mem & l1_ok
-    touch_i = is_comp & pI.hit
-    upg_d = touch_d & is_wr & (pD.state == E) if mesi_local \
-        else jnp.zeros_like(touch_d)
-    all_fill_d = fill_d | fill_bank_d
-    all_fill_i = fill_i | fill_bank_i
-    haz_d = _hazard(fill_d | upg_d, is_mem, pD.set_idx) \
-        | _hazard(touch_d | fill_d, fill_d, pD.set_idx)
-    haz_i = _hazard(fill_i, is_comp, pI.set_idx) \
-        | _hazard(touch_i | fill_i, fill_i, pI.set_idx)
-    # Banked (serve-time) fills: a later access in the SAME L1 SET could
-    # be hitting the line the future fill will evict.  Under SHARED L2
-    # that staleness is expensive (the L1 is the only local level — a
-    # missed eviction turns a remote slice round trip into a local hit),
-    # so same-set accesses stall, except a same-line covered re-access
-    # (that line IS the fill, never its victim).  Under a private
-    # (inclusive) L2 the evicted line falls back to the local L2, so the
-    # worst mispricing is one l2_ps — noise the 2% oracle absorbs — and
-    # stalling for it would cap chains at the L1 set count; no hazard.
-    # Banks themselves need no victim-staleness hazard: their victim is
-    # chosen at serve time, after every window effect has landed.
-    if P > 0 and shared_l2:
-        ssD = pD.set_idx[:, :, None] == pD.set_idx[:, None, :]
-        haz_d = haz_d | (is_mem & (
-            earlier & ssD & ~same_line_w
-            & fill_bank_d[:, None, :]).any(axis=2))
-        ssI = pI.set_idx[:, :, None] == pI.set_idx[:, None, :]
-        haz_i = haz_i | (is_comp & (
-            earlier & ssI & ~same_line_w
-            & fill_bank_i[:, None, :]).any(axis=2))
-    if P > 0:
-        # Uncovered same-line use of an IN-WINDOW bank always stalls
-        # (the no-duplicate-lines-per-chain invariant, window half).
-        bank_w_uncov = (mem_bank0 & ~is_wr) if wfwd else mem_bank0
-        uncov_w = earlier & same_line_w & (
-            (is_mem[:, :, None] & comp_bank0[:, None, :])
-            | (is_wr[:, :, None] & bank_w_uncov[:, None, :])
-            | (is_comp[:, :, None] & mem_bank0[:, None, :]))
-        hazard_uncov = uncov_w.any(axis=2)
-        haz_d = haz_d | (is_mem & hazard_uncov)
-        haz_i = haz_i | (is_comp & hazard_uncov)
-    hazard = haz_d | haz_i
-
-    # Banked-miss L2 hazards (private): the serve-time fill will touch
-    # the banked line's L2 set (choosing a victim then, against the
-    # post-serve state), so any L2-consulting event after a same-L2-set
-    # bank declines — except a covered same-line re-access.  The
-    # set-collision rule subsumes the inclusion hazard (the future L2
-    # victim lives in the same set as the banked line, so an L1 hit on
-    # it is a same-L2-set memory event).
-    l2_fill_cand = mem_bank | comp_bank
-    if P > 0 and not shared_l2:
-        l2ss = pL2.set_idx[:, :, None] == pL2.set_idx[:, None, :]
-        l2_cover = same_line_w & (
-            (is_mem[:, :, None] & mem_bank0[:, None, :]
-             & is_rd[:, :, None])
-            | (is_comp[:, :, None] & comp_bank0[:, None, :]))
-        if wfwd:
-            # A write covered by an earlier in-window EX bank is the
-            # fill itself, never its victim — exempt from the L2-set
-            # hazard like the covered reads above.
-            l2_cover = l2_cover | (
-                same_line_w & is_wr[:, :, None]
-                & (mem_bank0 & is_wr)[:, None, :])
-        hazard = hazard | ((is_mem | is_comp) & (
-            earlier & l2ss & ~l2_cover
-            & l2_fill_cand[:, None, :]).any(axis=2))
-
-    # Pending-chain hazards (stall-on-use across rounds): elements banked
-    # in EARLIER rounds have fills still outstanding; any window event
-    # whose probe could be invalidated by one of those future fills —
-    # same L1D/L1I set as a pending fill of its kind, or (private) same
-    # L2 set as any pending element — must wait for the chain to drain
-    # and re-probe the post-serve state, exactly where the reference's
-    # blocking core would still be stalled on the miss.  Covered exact-
-    # line matches forward instead (above).
-    if P > 0:
-        # Uncovered exact-line re-accesses of a pending element always
-        # stall, at every hierarchy shape: a write under a pending SH
-        # must re-probe for its upgrade miss, and an uncovered bankable
-        # use must NOT bank — no chain may ever hold one line twice
-        # (the fast pass's conflict-free groups rely on it).
-        pvT0 = pvalid.T[:, None, :]
-        haz_pend = (is_mem & jnp.any(
-            linematch_p & pvT0 & ~cover_pd, axis=2)) \
-            | (is_comp & jnp.any(
-                linematch_p & pvT0 & ~cover_pi, axis=2))
-        if shared_l2:
-            # L1-set staleness matters here (see the in-window variant).
-            pd_set = cachemod.set_index(pline, params.l1d.num_sets).T
-            pi_set = cachemod.set_index(pline, params.l1i.num_sets).T
-            haz_pend = haz_pend | (is_mem & jnp.any(
-                pend_memT & ~cover_pd
-                & (pD.set_idx[:, :, None] == pd_set[:, None, :]), axis=2)) \
-                | (is_comp & jnp.any(
-                    pend_ifT & ~cover_pi
-                    & (pI.set_idx[:, :, None] == pi_set[:, None, :]),
-                    axis=2))
-        else:
-            # Private L2: the L2-set hazard is the one that matters (a
-            # missed L2 victim eviction hides a full re-request).
-            p2_set = cachemod.set_index(pline, params.l2.num_sets).T
-            pvT = pvalid.T[:, None, :]
-            haz_pend = haz_pend | ((is_mem | is_comp) & jnp.any(
-                pvT & ~(cover_pd | cover_pi)
-                & (pL2.set_idx[:, :, None] == p2_set[:, None, :]),
-                axis=2))
-        hazard = hazard | haz_pend
-
-    # Retire classes.  Models disabled: the window retires NOTHING — tiles
-    # go one event per general slot, exactly the round-2 lockstep.  ROI
-    # markers (ENABLE/DISABLE_MODELS) are slot-synchronized across tiles in
-    # the reference's broadcast sense; letting tiles fast-forward K events
-    # per round while the flag is off races them past their own ENABLE
-    # point relative to other tiles (test_roi_gates_counters_and_time).
-    br_abs = iocoom and not params.core.speculative_loads
-    if br_abs and params.core.mixed:
-        # Branches drain only on iocoom tiles; simple tiles retire them
-        # in the relative (max-plus) class as always.
-        _iot_w = jnp.asarray(params.core.iocoom_mask)[:, None]
-        br_rel = is_br & ~_iot_w
-        br_drain = is_br & _iot_w
-    elif br_abs:
-        br_rel = jnp.zeros_like(is_br)
-        br_drain = is_br
-    else:
-        br_rel = is_br
-        br_drain = jnp.zeros_like(is_br)
-    base_ok = valid_ev & ~hazard & en
-    ok_rel = (comp_simple | mem_simple | br_rel) & base_ok
-    ok_abs = (is_stall | is_sync | is_spawn | br_drain) & base_ok
-    ok_bank = (mem_bank | comp_bank) & base_ok
-    ok = ok_rel | ok_abs | ok_bank            # retire-capable (BP masking)
-
-    # ---- branch predictor: within-window read-after-write on table slots
-    if params.core.bp_type == "none":
-        correct = jnp.ones_like(is_br)
-        bidx = None
-    else:
-        bidx = (addr % params.core.bp_size).astype(jnp.int32)
-        tbl_pred = jnp.take_along_axis(st.bp_table, bidx, axis=1)
-        same_slot = bidx[:, :, None] == bidx[:, None, :]      # [T, Kj, Ki]
-        taken = arg != 0
-        # latest earlier in-window branch writing my slot (it must also
-        # actually retire — handled below by masking with the final
-        # retire prefix: an unretired event can't have written the table.
-        # Since retirement is a prefix, any i < j with j retired is also
-        # retired, so the pure i<j mask is already exact.)
-        w_mask = earlier & same_slot & (is_br & ok)[:, None, :]  # [T,Kj,Ki]
-        has_w = w_mask.any(axis=2)
-        last_w = jnp.argmax(
-            jnp.where(w_mask, ar[None, None, :], -1), axis=2)
-        pred_blk = jnp.take_along_axis(taken, last_w, axis=1)
-        pred = jnp.where(has_w, pred_blk, tbl_pred)
-        correct = pred == taken
-
-    # ---- per-event dt (int64 ps) and clock floors
-    # (arg2 low 20 bits: COMPUTE icount; high bits carry register
-    # annotations — see the complex slot's scoreboard)
-    icount_ev = jnp.maximum(arg2 & ((1 << 20) - 1), 0).astype(jnp.int64)
-    n_lines = jnp.maximum(
-        (icount_ev * ICACHE_BYTES_PER_INSTRUCTION + params.line_size - 1)
-        // params.line_size, 1)
-    cost_ps = _lat(jnp.maximum(arg, 0), p_core)
-    fetch_ps = icount_ev * l1i_ps
-    dt_comp = cost_ps + fetch_ps \
-        + jnp.where(comp_l2, n_lines * l2_ps, 0)
-    dt_br = jnp.where(correct, cycle_ps,
-                      _lat(vp.bp_mispredict_penalty, p_core)) \
-        + l1i_ps
-    dt_mem = jnp.where(mem_l2, l1d_ps + l2_ps, l1d_ps)
-    dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
-    dt = jnp.zeros((T, K), dtype=jnp.int64)
-    dt = jnp.where(is_comp, dt_comp, dt)
-    dt = jnp.where(is_br, dt_br, dt)
-    dt = jnp.where(is_mem, dt_mem, dt)
-    dt = jnp.where(is_sync, cost_ps, dt)
-    # Models off: compute/branch/memory are free, but SYNC/SPAWN still pay
-    # their cost and STALL/SYNC floors still apply (old-slot semantics).
-    dt = jnp.where(en, dt, jnp.where(is_sync, cost_ps, 0))
-    dt = jnp.where(is_spawn, dt_spawn, dt)
-    NEGF = jnp.int64(-(2**62))
-    floor = jnp.where(is_stall | is_sync, addr, NEGF)
-    if iocoom:
-        floor = jnp.where(drain_ev, jnp.maximum(floor, drain_t), floor)
-
-    # ---- max-plus prefix: clk_{j+1} = max(clk_j, floor_j) + dt_j over the
-    # retired prefix.  With chaining, a banked miss switches the tile to
-    # the RELATIVE clock (rel since the unknown completion); absolute-only
-    # events then stop the prefix until the chain drains.  Boundary check:
-    # absolute clock against the quantum boundary, or rel against one
-    # quantum of post-miss overrun.
-    qps = vp.quantum_ps
-    # Request-issue offset (local tag checks before the request leaves —
-    # complex-slot `issue` math): L1 access + L2 tag check (L1-only under
-    # shared L2).
-    miss_tags_ps = cycle_ps if shared_l2 else \
-        _lat(vp.l2_tags_access_cycles, p_l2)
-    issue_off = jnp.where(is_comp, l1i_ps, l1d_ps) + miss_tags_ps
-    clk = st.clock
-    rel = st.chain_rel if P > 0 else jnp.zeros(T, dtype=jnp.int64)
-    nm = nm0
-    n_ret = jnp.zeros(T, dtype=jnp.int32)
-    run = tile_active
-    clks = []
-    bank_marks, bank_slots, bank_deltas = [], [], []
-    for j in range(K):
-        clks.append(clk)                     # clock BEFORE event j
-        if P > 0:
-            bank_j = ok_bank[:, j] & (nm < P)
-            okj = ok_rel[:, j] | (ok_abs[:, j] & (nm == 0)) | bank_j
-            # Mid-chain run-ahead exists only to DISCOVER the rest of
-            # the chain: once the bank is full, the tile stalls for the
-            # resolve pass instead of retiring further hits against
-            # going-stale probes (they cost the same rounds after the
-            # drain, re-probed against post-serve state).  Empty-chain
-            # tiles retire into the spanned bound (see wbound above).
-            in_b = jnp.where(nm == 0, clk < wbound,
-                             (rel < qps) & (nm < P))
-        else:
-            bank_j = jnp.zeros(T, dtype=bool)
-            okj = ok_rel[:, j] | ok_abs[:, j]
-            in_b = clk < st.boundary
-        can = run & okj & in_b
-        bankc = can & bank_j
-        if P > 0:
-            bank_marks.append(bankc)
-            bank_slots.append(nm)
-            bank_deltas.append(
-                jnp.where(nm == 0, clk, rel) + issue_off[:, j])
-            abs_step = can & (nm == 0) & ~bankc
-            rel_step = can & (nm > 0) & ~bankc
-            rel = jnp.where(bankc, 0,
-                            jnp.where(rel_step, rel + dt[:, j], rel))
-            nm = nm + bankc.astype(jnp.int32)
-        else:
-            abs_step = can
-        clk = jnp.where(abs_step,
-                        jnp.maximum(clk, floor[:, j]) + dt[:, j], clk)
-        n_ret = n_ret + can.astype(jnp.int32)
-        run = can
-    clk_before = jnp.stack(clks, axis=1)                      # [T, K]
-    retired = ar[None, :] < n_ret[:, None]                    # [T, K]
+    S_ids = st.spawned_at.shape[0]
+    wi = kwindow.WindowIn(
+        meta=meta, addr=addr, valid_ev=valid_ev, tile_active=tile_active,
+        tile_ids=jnp.arange(T, dtype=jnp.int32),
+        clock=st.clock, period_ps=st.period_ps, bp_table=st.bp_table,
+        l1i_word=st.l1i.word, l1i_rr=st.l1i.rr_ptr,
+        l1d_word=st.l1d.word, l1d_rr=st.l1d.rr_ptr,
+        l2_word=None if shared_l2 else st.l2.word,
+        l2_rr=None if shared_l2 else st.l2.rr_ptr,
+        boundary=st.boundary, models_enabled=st.models_enabled,
+        stamp_base=_stamp_base(st),
+        chain_rel=st.chain_rel if P > 0 else None,
+        mq_count=st.mq_count if P > 0 else None,
+        mq_head=st.mq_head if P > 0 else None,
+        mq_req=st.mq_req if P > 0 else None,
+        mq_delta=st.mq_delta if P > 0 else None,
+        mq_extra=st.mq_extra if P > 0 else None,
+        lq_ready=st.lq_ready if iocoom else None,
+        sq_ready=st.sq_ready if iocoom else None,
+    )
+    out = kwindow.run_window(params, vp, wi, S_ids,
+                             kdispatch.window_mode(params))
 
     # ---- SPAWN: start the child's stream once the request lands on its
-    # tile (ThreadManager::spawnThread path; a chain of spawns — how every
-    # trace launches its tiles — retires K per round here instead of one
-    # per general slot).
-    # ``child`` is a STREAM id; its tile is the scheduler's static
-    # round-robin placement (child % T; identity when streams == tiles).
-    S_ids = st.spawned_at.shape[0]
-    child = jnp.clip(arg2, 0, S_ids - 1)
-    spawn_base = jnp.maximum(clk_before, floor) if iocoom else clk_before
-    spawn_land = spawn_base + dt_spawn + noc.unicast_ps(
-        params.net_user, jnp.broadcast_to(rows[:, None], (T, K)),
-        child % T, 8, _period(st, DVFSModule.NETWORK_USER)[:, None],
-        params.mesh_width, vnet=vp.net_user)
+    # tile — the walk's one cross-tile effect, applied here as a single
+    # scatter-max over the returned (mask, child, landing-time) triples.
     spawned_at = st.spawned_at.at[
-        jnp.where(is_spawn & retired, child, S_ids)].max(
-        spawn_land, mode="drop")
+        jnp.where(out.spawn_mask, out.spawn_child, S_ids)].max(
+        out.spawn_land, mode="drop")
 
-    # ---- apply cache effects (stamps encode within-window order)
-    stamp = (_stamp_base(st) + ar)[None, :]
-    enb = jnp.broadcast_to(jnp.asarray(en), (T, K))
-    l1i = cachemod.touch(st.l1i, pI.set_idx, pI.way,
-                         touch_i & retired & enb,
-                         _row_word(pI.row, pI.way), stamp)
-    d_word = _row_word(pD.row, pD.way)
-    # MESI silent E->M upgrade on a store hit to an E-granted line folds
-    # into the touch scatter (the upgraded word wins the .max).
-    if mesi_local:
-        d_word = cachemod.with_state(
-            d_word, jnp.where(is_wr & (pD.state == E), M, pD.state))
-    l1d = cachemod.touch(st.l1d, pD.set_idx, pD.way,
-                         touch_d & retired & enb, d_word, stamp)
-    l2 = st.l2
-    if not shared_l2:
-        # L2 touches for window L2 hits (fills + i-fetch paths).
-        l2 = cachemod.touch(st.l2, pL2.set_idx, pL2.way,
-                            (mem_l2 | comp_l2) & retired & enb,
-                            _row_word(pL2.row, pL2.way), stamp)
-
-    # Window fills — L1 fills from local L2 hits AND banked-miss installs,
-    # all at once: the hazard rules guarantee distinct sets per window, so
-    # the [T, K] scatter can't collide, victim picks from window-start
-    # stamps are exact, and (private protocols) L1 victims fold into the
-    # inclusive L2 copy (timing-only, as in the round-2 engine).  Returns
-    # the per-event victim (tag, state) for the banked-victim record
-    # (meaningful where the fill allocated a way).
-    def _apply_fills(cache, fills, probe, fill_state, cp):
-        act = fills & retired & enb
-        st_row = cachemod.word_state(probe.row)       # [A, T, K]
-        invalid = st_row == cachemod.I
-        has_inv = invalid.any(axis=0)
-        first_inv = jnp.argmax(invalid, axis=0)
-        lru_way = jnp.argmin(cachemod.word_stamp(probe.row), axis=0)
-        vic_way = jnp.where(has_inv, first_inv, lru_way)
-        # Resident upgrade (a write to an S-line whose M copy sits in
-        # L2 re-installs in place) keeps the probe's way.
-        fway = jnp.where(probe.hit, probe.way,
-                         vic_way).astype(jnp.int32)
-        new_word = cachemod.pack_word(
-            line.astype(jnp.int32), stamp, fill_state)
-        if cp.replacement == "round_robin":
-            # Pointer advances on EVERY non-resident install (even
-            # into an invalid way) — must match cachemod.fill, the
-            # complex-slot/resolve path, or victim choices diverge
-            # between block_events settings.
-            adv = act & ~probe.hit
-            rr = jnp.take_along_axis(cache.rr_ptr, probe.set_idx,
-                                     axis=1)
-            A = cache.word.shape[0]
-            fway = jnp.where(probe.hit, probe.way,
-                             jnp.where(has_inv, first_inv, rr % A))
-            cache = cache._replace(rr_ptr=cache.rr_ptr.at[
-                jnp.where(adv, rows[:, None], T), probe.set_idx].set(
-                (rr + 1) % A, mode="drop"))
-        vic_word = _row_word(probe.row, fway)
-        vic_tag = cachemod.word_tag(vic_word).astype(jnp.int64)
-        vic_state = jnp.where(probe.hit, I, cachemod.word_state(vic_word))
-        cache = cache._replace(word=cache.word.at[
-            fway, jnp.where(act, rows[:, None], T), probe.set_idx].set(
-            new_word, mode="drop"))
-        return cache, vic_tag, vic_state
-
-    if not shared_l2:
-        # Banked misses do NOT fill here — the resolve pass installs the
-        # line at serve time (blocking semantics), choosing its victim
-        # against the post-serve cache state.
-        l1d, _, _ = _apply_fills(
-            l1d, fill_d, pD,
-            jnp.where(is_wr, M, S).astype(jnp.int32), params.l1d)
-        l1i, _, _ = _apply_fills(
-            l1i, fill_i, pI,
-            jnp.full((T, K), S, dtype=jnp.int32), params.l1i)
-
-    # ---- branch-predictor table: last retired write per slot wins
-    bp_table = st.bp_table
-    if bidx is not None:
-        wr_ev = is_br & retired & enb
-        later_same = (earlier.transpose(0, 2, 1) & same_slot
-                      & wr_ev[:, None, :]).any(axis=2)
-        winner = wr_ev & ~later_same
-        SZ = params.core.bp_size
-        if T * K * SZ <= dense.DENSE_MAX_ELEMS:
-            # Dense [T, K, SZ] masked update — the scatter form lowers to
-            # a serialized sort on TPU ([T, K] 2-D indices).
-            oh = (bidx[:, :, None]
-                  == jnp.arange(SZ, dtype=jnp.int32)[None, None, :]) \
-                & winner[:, :, None]
-            wrote = oh.any(axis=1)
-            val = (oh & taken[:, :, None]).any(axis=1)
-            bp_table = jnp.where(wrote, val, bp_table)
-        else:
-            bp_table = bp_table.at[
-                rows[:, None], jnp.where(winner, bidx, SZ)
-            ].set(taken, mode="drop")
-
-    # ---- counters
     c = st.counters
-
-    def msum(mask, val=1):
-        v = jnp.asarray(val)
-        v = jnp.broadcast_to(v, (T, K)) if v.ndim < 2 else v
-        return jnp.sum(jnp.where(mask & retired & enb, v.astype(jnp.int64),
-                                 0), axis=1)
-
-    c = c._replace(
-        icount=c.icount + msum(is_comp, icount_ev)
-        + msum((is_mem & ((arg2 & 0xFF) == 0)) | is_br),
-        l1i_access=c.l1i_access + msum(is_comp, icount_ev) + msum(is_br),
-        # Forwarded re-accesses are the hits the oracle counts after the
-        # fill, not fresh misses.
-        l1i_miss=c.l1i_miss + msum(is_comp & ~pI.hit & ~comp_fwd, n_lines),
-        l1d_read=c.l1d_read + msum(is_rd),
-        l1d_read_miss=c.l1d_read_miss + msum(is_rd & ~l1_ok & ~mem_fwd),
-        l1d_write=c.l1d_write + msum(is_wr),
-        l1d_write_miss=c.l1d_write_miss
-        + msum(is_wr & ~l1_ok & ~mem_fwd),
-        l2_access=c.l2_access if shared_l2
-        else c.l2_access + msum(mem_l2 | comp_l2 | l2_fill_cand),
-        l2_miss=c.l2_miss if shared_l2
-        else c.l2_miss + msum(l2_fill_cand),
-        branches=c.branches + msum(is_br),
-        mispredicts=c.mispredicts + msum(is_br & ~correct),
-        spawns=c.spawns + msum(is_spawn),
-    )
+    c = c._replace(**{
+        name: getattr(c, name) + out.ctr_inc[i]
+        for i, name in enumerate(kwindow.WINDOW_CTRS)})
 
     st = st._replace(
-        clock=clk,
-        cursor=st.cursor + n_ret,
-        l1i=l1i, l1d=l1d, l2=l2,
-        bp_table=bp_table,
+        clock=out.clock,
+        cursor=st.cursor + out.n_ret,
+        l1i=st.l1i._replace(word=out.l1i_word, rr_ptr=out.l1i_rr),
+        l1d=st.l1d._replace(word=out.l1d_word, rr_ptr=out.l1d_rr),
+        l2=st.l2 if shared_l2
+        else st.l2._replace(word=out.l2_word, rr_ptr=out.l2_rr),
+        bp_table=out.bp_table,
         spawned_at=spawned_at,
         round_ctr=st.round_ctr + 1,
         ctr_window=st.ctr_window + 1,
         counters=c,
     )
-
-    # ---- record banked chain elements ([T, K] window results -> the
-    # [P, T] chain arrays, via a dense slot one-hot — no scatter ops).
     if P > 0:
-        bank_mark = jnp.stack(bank_marks, axis=1)    # [T, K]
-        bank_slot = jnp.stack(bank_slots, axis=1)
-        bank_delta = jnp.stack(bank_deltas, axis=1)
-        kind_ev = jnp.where(is_comp, PEND_IFETCH,
-                            jnp.where(is_wr, PEND_EX_REQ, PEND_SH_REQ))
-        req_val = kind_ev.astype(jnp.int64) | (line << 8)
-        # Local cost folded into the served completion (complex-slot
-        # `extra` math): a blocked COMPUTE's execution + fetch time minus
-        # the remotely fetched first line; memory operands owe nothing
-        # (atomics never bank).
-        extra_val = jnp.where(
-            is_comp,
-            cost_ps + fetch_ps
-            + (0 if shared_l2 else (n_lines - 1) * l2_ps),
-            jnp.int64(0))
-        slot_oh = (bank_slot[None] == jnp.arange(P)[:, None, None]) \
-            & bank_mark[None]                        # [P, T, K]
-        anyb = slot_oh.any(axis=2)
-
-        def put(dst, val):
-            v = jnp.sum(jnp.where(slot_oh, val[None], 0),
-                        axis=2).astype(dst.dtype)
-            return jnp.where(anyb, v, dst)
-
         st = st._replace(
-            mq_req=put(st.mq_req, req_val),
-            mq_delta=put(st.mq_delta, bank_delta),
-            mq_extra=put(st.mq_extra, extra_val),
-            mq_count=nm,
-            chain_rel=jnp.where(nm > 0, rel, 0),
+            mq_req=out.mq_req,
+            mq_delta=out.mq_delta,
+            mq_extra=out.mq_extra,
+            mq_count=out.mq_count,
+            chain_rel=out.chain_rel,
         )
     return st
 

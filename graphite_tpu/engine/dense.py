@@ -81,3 +81,77 @@ def stacked_set_table(idx: jnp.ndarray, mask: jnp.ndarray,
     duplicate-index write order XLA leaves unspecified never matters."""
     size = tbl.shape[1]
     return tbl.at[:, jnp.where(mask, idx, size)].set(vals, mode="drop")
+
+
+# FCFS election helpers — shared by engine/resolve.py's conflict rounds
+# and the chain replay's classify kernel (engine/kernels/chain.py), so
+# both paths run literally the same election code (round 10 moved them
+# here from resolve.py; semantics unchanged).
+
+BIG = jnp.int64(2**62)
+
+
+def home_fold(line: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Line -> home slot in [0, n): round-robin over consecutive lines
+    with the bits above the slot index XOR-folded in first — a plain
+    ``line % n`` sends every power-of-two-strided per-tile region to
+    ONE home, serializing all T cold misses through a single directory
+    set's way election (see resolve.home_of_line).  ONE definition:
+    resolve.py's home/DRAM-site lookups and the chain classify kernel's
+    slice->controller timing legs must never diverge."""
+    bits = max(n.bit_length() - 1, 1)
+    x = line ^ (line >> bits) ^ (line >> (2 * bits)) ^ (line >> (3 * bits))
+    return (x % n).astype(jnp.int32)
+
+
+def fcfs_keys(active, issue) -> jnp.ndarray:
+    """Per-row FCFS key ordered by (issue, tile), unique per row.
+
+    Issue times are rebased to the earliest active row so the key stays
+    far below the ``BIG`` empty-slot sentinel at any simulated time
+    (skew within one resolve pass is bounded by quantum + max latency,
+    nowhere near the 2^40 clip).
+    """
+    T = issue.shape[0]
+    rows = jnp.arange(T)
+    issue0 = jnp.min(jnp.where(active, issue, BIG))
+    return jnp.clip(issue - issue0, 0, jnp.int64(2**40)) * T + rows
+
+
+def elect(active, packed, idx, size):
+    """Min-FCFS election: the earliest active row per ``idx`` value wins
+    (one winner per table slot; a hash collision between two distinct
+    keys mapping to one slot only defers the later row).
+
+    Dense [R, size] mask form when it fits; scatter-min table above the
+    size cap (large T), where the serialized scatter is amortized anyway.
+    """
+    R = packed.shape[0]
+    if R * size <= DENSE_MAX_ELEMS:
+        oh = onehot(idx, size)
+        tbl = jnp.min(jnp.where(oh & active[:, None], packed[:, None], BIG),
+                      axis=0)
+        return active & (sel(oh, tbl) == packed)
+    tbl = jnp.full((size,), BIG, dtype=jnp.int64).at[
+        jnp.where(active, idx, size)].min(packed, mode="drop")
+    return active & (tbl[idx] == packed)
+
+
+def grouped_rank(group: jnp.ndarray, key: jnp.ndarray,
+                 active: jnp.ndarray) -> jnp.ndarray:
+    """FCFS rank of each active row within its ``group``, ordered by
+    ``key``, as ONE dense [R, R] masked compare-and-sum.
+
+    Deliberately dense: [R, R] bool work is a few MB of fused vector ops
+    even at R = 2048, while sort-based ranking lowers to a serialized
+    while-loop of dynamic-update-slices on TPU.  Key ties break by row
+    index.  Inactive rows get rank 0.
+    """
+    R = key.shape[0]
+    idx = jnp.arange(R, dtype=jnp.int32)
+    g = group.astype(jnp.int32)
+    before = (g[None, :] == g[:, None]) \
+        & ((key[None, :] < key[:, None])
+           | ((key[None, :] == key[:, None]) & (idx[None, :] < idx[:, None]))) \
+        & active[None, :] & active[:, None]
+    return jnp.sum(before, axis=1, dtype=jnp.int32)

@@ -1,0 +1,28 @@
+"""Pallas TPU round-cost kernels (round 10; ``tpu/pallas_kernels``).
+
+The engine's round COST on TPU is dominated by dispatch: the block
+window's K-deep walk and the chain replay's per-iteration table phases
+each lower to dozens of small sequential XLA ops (~150 us dispatch each
+at T = 1024 — PROFILE.md), while the arithmetic itself is integer work
+over arrays that fit VMEM many times over.  This package runs those
+phases as FUSED Pallas kernels — the ZSim bound-weave / Sniper
+interval-core move: once event ordering is settled, per-event timing
+arithmetic should run at memory speed, not dispatch speed.
+
+Layout:
+  * ``dispatch.py`` — mode resolution (lax / interpret / tpu), the
+    pallas_call plumbing shared by both kernels, and structural-evidence
+    helpers (jaxpr op counts) for bench.py / PROFILE.md.
+  * ``window.py``   — the block-window walk (engine/core._block_retire's
+    hot loop) as a pure per-tile function + its fused kernel wrapper.
+  * ``chain.py``    — the chain replay iteration's classify/elect/
+    combine/price sub-chain (engine/resolve.chain_fast_pass) + wrapper.
+
+The kernels are NOT reimplementations: each wraps the SAME pure
+walk/classify function the lax path calls inline, executed on
+block-sliced operands inside one ``pl.pallas_call``.  All arithmetic is
+integer and per-tile independent, so kernels-on is bit-identical to
+kernels-off by construction — enforced by tests/test_kernels.py.
+"""
+
+from graphite_tpu.engine.kernels import dispatch  # noqa: F401

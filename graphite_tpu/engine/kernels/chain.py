@@ -1,0 +1,394 @@
+"""The chain replay iteration's classify/elect/combine/price sub-chain.
+
+Each ``chain_fast_pass`` iteration (engine/resolve.py) serves every
+tile's current chain head with the round loop's exact math.  Its cost on
+TPU is the long chain of small sequential table ops over the shared hash
+index — victim-way exclusion tables, the (home, dset, way) FCFS
+election, fan-out/owner delivery budgets, SH-combining rep tables — plus
+the directory transition and the zero-load timing legs, each a [T]-wide
+op paying its own dispatch.  ``chain_classify`` extracts that whole
+sub-chain as ONE pure function shared by both paths:
+
+  * lax (``tpu/pallas_kernels`` off): called inline — the program is
+    the pre-round-10 iteration, value for value;
+  * fused (interpret / tpu): the same function inside one
+    ``pl.pallas_call`` (single grid step: the hash tables are global
+    over tiles, and [T]- and [H]-sized operands fit VMEM comfortably at
+    every supported T), so the P replay iterations cost P kernel
+    dispatches instead of P x dozens.
+
+What stays OUTSIDE the kernel, by design:
+  * the [P, T] chain-head gathers and the big dir_word / dir_sharers
+    row gathers (one XLA gather each — not the dispatch chain);
+  * the DRAM queue-model probe (its ring state is loop-carried through
+    the engine; with ``dram/queue_model_enabled = false`` the kernel
+    also absorbs the completion math and the per-line floor write);
+  * the apply scatters (directory install, sharer-bitmap add, cache
+    invalidation sweeps and fills, counters) — stacked multi-field
+    scatters since round 6.
+
+All values are integer and the function is deterministic, so
+kernels-on == kernels-off bit-exactly (tests/test_kernels.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.engine import dense
+from graphite_tpu.engine import directory as dirmod
+from graphite_tpu.engine import noc
+from graphite_tpu.engine.kernels import dispatch
+from graphite_tpu.engine.state import (dword_owner, dword_stamp,
+                                       dword_state, dword_tag)
+from graphite_tpu.engine.vparams import VariantParams
+from graphite_tpu.params import SimParams
+
+I, S, O, E, M = (cachemod.I, cachemod.S, cachemod.O, cachemod.E,
+                 cachemod.M)
+
+# Control-message payload bytes (request/inv/ack packets).
+CTRL_BYTES = 8
+
+# Per-target budget of point-to-point owner flush/downgrade deliveries
+# per conflict round / replay iteration.
+J_OWN = 8
+
+
+def _lat(cycles, period_ps):
+    return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)
+
+
+class ChainIn(NamedTuple):
+    """One replay iteration's classify operands (all [T] unless noted).
+    The hash tables are global over tiles, so there is no tile blocking
+    — every axis entry is None (single grid step)."""
+
+    active: jnp.ndarray      # bool
+    is_ex: jnp.ndarray       # bool
+    is_if: jnp.ndarray       # bool
+    line: jnp.ndarray        # int64
+    issue: jnp.ndarray       # int64
+    extra: jnp.ndarray       # int64 (local cost owed at completion)
+    home: jnp.ndarray        # int32
+    dset: jnp.ndarray        # int32
+    fidx: jnp.ndarray        # int32 flat (home * ndsets + dset)
+    hidx: jnp.ndarray        # int32 hash slot of the line
+    drow: jnp.ndarray        # [T, A] int64 gathered directory words
+    dsharers: jnp.ndarray    # [T, A, W] uint64 gathered sharer words
+    p_net: jnp.ndarray       # int32 periods
+    p_dir: jnp.ndarray
+    p_l2: jnp.ndarray
+    p_l1d: jnp.ndarray
+    p_l1i: jnp.ndarray
+    p_core: jnp.ndarray
+    ftbl: Optional[jnp.ndarray]  # [2, H] int64 — present iff the
+    #   kernel owns the floor write (DRAM queue model off)
+
+
+CHAIN_IN_AXES = {f: None for f in ChainIn._fields}
+
+
+class ChainOut(NamedTuple):
+    way: jnp.ndarray            # [T] int32 (post-combining)
+    hit: jnp.ndarray            # bool — directory-entry hit
+    serve: jnp.ndarray          # bool — election winners
+    serve_all: jnp.ndarray      # bool — winners + combining members
+    member: jnp.ndarray         # bool
+    member_add: jnp.ndarray     # bool — member bit-add guard
+    hard_stop: jnp.ndarray      # bool — chain demotes to the round loop
+    fan_go: jnp.ndarray         # bool — in-pass fan-out serves
+    owner_leg: jnp.ndarray      # bool — served owner flush/downgrade
+    evicting: jnp.ndarray       # bool
+    owner: jnp.ndarray          # [T] int32 owner tile
+    ow_slot: jnp.ndarray        # [T] int32 min(posr, J_OWN - 1)
+    down_to: jnp.ndarray        # [T] int32 owner downgrade state
+    new_state: jnp.ndarray      # [T] int32 directory entry after
+    new_owner: jnp.ndarray      # [T] int32
+    delta_sh: jnp.ndarray       # [T, W] uint64 sharer-bitmap delta
+    dram_read: jnp.ndarray      # bool — act.dram_read (pre-serve mask)
+    dram_write: jnp.ndarray     # bool — act.dram_write
+    need_read: jnp.ndarray      # bool — serve_all & dram_read
+    dram_wb: jnp.ndarray        # bool — dram_write & serve_all
+    t_dir: jnp.ndarray          # [T] int64
+    owner_ps: jnp.ndarray       # [T] int64
+    inv_ps: jnp.ndarray         # [T] int64 (zeros with fanout off)
+    reply_ps: jnp.ndarray       # [T] int64
+    from_dram_ps: jnp.ndarray   # [T] int64
+    dram_arrival: jnp.ndarray   # [T] int64
+    l1_fill_ps: jnp.ndarray     # [T] int64
+    inv_bool: Optional[jnp.ndarray]   # [KF, T] bool (fanout only)
+    line_fr: Optional[jnp.ndarray]    # [KF] int64 (fanout only)
+    inv_count: jnp.ndarray      # [T] int64
+    completion: Optional[jnp.ndarray]  # [T] int64 (queue off only)
+    t_data: Optional[jnp.ndarray]      # [T] int64 (queue off only)
+    ftbl: Optional[jnp.ndarray]        # [2, H] int64 (queue off only)
+
+
+CHAIN_OUT_AXES = {f: None for f in ChainOut._fields}
+
+
+def chain_classify(params: SimParams, vp: VariantParams, ci: ChainIn,
+                   H: int) -> ChainOut:
+    """One replay iteration's classification — engine/resolve.py's
+    slot_body from the directory probe through the timing legs, verbatim
+    apart from the operand plumbing (see chain_fast_pass for the
+    semantics commentary)."""
+    T = params.num_tiles
+    A = params.directory.associativity
+    W = ci.dsharers.shape[2]
+    ndsets = params.directory.num_sets
+    rows = jnp.arange(T)
+    shared_l2 = params.shared_l2
+    fanout = params.fanout_replay
+    KF = min(params.max_inv_fanout_per_round, T)
+
+    active, is_ex, is_if = ci.active, ci.is_ex, ci.is_if
+    line, issue = ci.line, ci.issue
+    home, dset, fidx, hidx = ci.home, ci.dset, ci.fidx, ci.hidx
+    p_net, p_dir = ci.p_net, ci.p_dir
+    ack_ps = _lat(vp.inv_ack_cycles, ci.p_core)
+
+    # ---- directory probe at (home, dset) — post-predecessor state
+    drow = ci.drow                                        # [T, A]
+    dstate = dword_state(drow)
+    dstamp = dword_stamp(drow)
+    match = (dword_tag(drow) == line[:, None].astype(jnp.int32)) \
+        & (dstate != I)
+    hit = match.any(axis=1) & active
+    hway = jnp.argmax(match, axis=1).astype(jnp.int32)
+    invalid = dstate == I
+
+    # ---- victim way for allocs: invalid first, then stamp-LRU,
+    # ways held by this slot's hit elements excluded
+    fhash = (dense.fmix64(fidx.astype(jnp.int64))
+             % jnp.uint64(H)).astype(jnp.int32)
+    used_tbl = jnp.zeros((H, A), dtype=bool).at[
+        jnp.where(hit, fhash, H), hway].set(True, mode="drop")
+    hway_used = used_tbl[fhash]                            # [T, A]
+    NEVER = jnp.int32(2**31 - 1)
+    vkey = jnp.where(hway_used, NEVER,
+                     jnp.where(invalid, -1, dstamp))
+    miss_way = jnp.argmin(vkey, axis=1).astype(jnp.int32)
+    can_alloc = active & ~hit & (jnp.take_along_axis(
+        vkey, miss_way[:, None], axis=1)[:, 0] != NEVER)
+    way = jnp.where(hit, hway, miss_way)
+
+    # ---- way-slot election
+    am = (home.astype(jnp.int64) * ndsets + dset) * A + way
+    aidx = (dense.fmix64(am) % jnp.uint64(H)).astype(jnp.int32)
+    packed = dense.fcfs_keys(active, issue)
+    wslot = dense.elect(active, packed, aidx, H)
+
+    # ---- transition against the replayed entry
+    way_word = jnp.take_along_axis(drow, way[:, None], axis=1)[:, 0]
+    way_state = dword_state(way_word)
+    way_owner = dword_owner(way_word)
+    dsharers = ci.dsharers                                # [T, A, W]
+    entry_row = jnp.take_along_axis(
+        dsharers, way[:, None, None], axis=1)[:, 0, :]    # [T, W]
+    entry_state = jnp.where(hit, way_state, I)
+    entry_owner = jnp.where(hit, way_owner, -1)
+    entry_sharers = jnp.where(hit[:, None], entry_row,
+                              jnp.zeros((T, W), dtype=jnp.uint64))
+    act = dirmod.transition(params.protocol_kind, is_ex, rows,
+                            entry_state, entry_owner, entry_sharers,
+                            W, is_ifetch=is_if)
+    has_inv = (act.inv_targets != jnp.uint64(0)).any(axis=1)
+    vic_dead = (way_state == I) \
+        | (((way_state == S) | (way_state == O))
+           & (entry_row == jnp.uint64(0)).all(axis=1))
+    cand0 = active & wslot & (hit | (can_alloc & vic_dead))
+    if fanout:
+        need_fan = cand0 & has_inv
+        fan_rank = jnp.sum(
+            (packed[None, :] < packed[:, None]) & need_fan[None, :]
+            & need_fan[:, None], axis=1, dtype=jnp.int32)
+        fan_sel = need_fan & (fan_rank < KF)
+        cand = cand0 & (~has_inv | fan_sel)
+    else:
+        fan_rank = jnp.zeros(T, dtype=jnp.int32)
+        cand = cand0 & ~has_inv
+    owner = act.owner_tile
+    posr = dense.grouped_rank(owner, packed, cand & act.owner_leg)
+    serve = cand & ~(act.owner_leg & (posr >= J_OWN))
+    owner_leg = act.owner_leg & serve
+    fan_go = serve & has_inv          # in-pass fan-out serves
+    evicting = serve & ~hit & (way_state != I)
+
+    # ---- SH combining within the slot (the round loop's combining)
+    sh_ok_e = (entry_state == I) | (entry_state == S)
+    if shared_l2:
+        sh_ok_e = sh_ok_e & (entry_state != I)
+    ex_any_t = jnp.zeros((H,), dtype=bool).at[
+        jnp.where(active & is_ex, hidx, H)].set(True, mode="drop")
+    rep_sh = serve & ~is_ex & sh_ok_e
+    rep_line_t = jnp.full((H,), -1, jnp.int64).at[
+        jnp.where(rep_sh, hidx, H)].set(line, mode="drop")
+    rep_way_t = jnp.zeros((H,), jnp.int32).at[
+        jnp.where(rep_sh, hidx, H)].set(way, mode="drop")
+    member = active & ~serve & ~is_ex & sh_ok_e & ~ex_any_t[hidx] \
+        & (rep_line_t[hidx] == line)
+    way = jnp.where(member, rep_way_t[hidx], way)
+    serve_all = serve | member
+    stop_inv = has_inv if not fanout else jnp.zeros_like(has_inv)
+    hard_stop = active & ~serve_all \
+        & (stop_inv | (can_alloc & ~vic_dead) | (~hit & ~can_alloc)
+           | (act.owner_leg & (posr >= J_OWN)))
+
+    # ---- timing: the round loop's zero-load path for a fast element
+    net_req = noc.unicast_ps(params.net_memory, rows, home,
+                             CTRL_BYTES, p_net, params.mesh_width,
+                             vnet=vp.net_memory)
+    p_net_home = jnp.take_along_axis(p_net, home, axis=0)
+    reply_ps = noc.unicast_ps(params.net_memory, home, rows,
+                              params.line_size + CTRL_BYTES,
+                              p_net_home, params.mesh_width,
+                              vnet=vp.net_memory)
+    dir_ps = _lat(vp.dir_access_cycles,
+                  jnp.take_along_axis(p_dir, home, axis=0))
+    arrive = issue + net_req
+    t_dir = arrive + dir_ps
+    p_net_own = jnp.take_along_axis(p_net, owner, axis=0)
+    if shared_l2:
+        l2_own_ps = _lat(vp.l1d_access_cycles,
+                         jnp.take_along_axis(ci.p_l1d, owner, axis=0))
+    else:
+        l2_own_ps = _lat(vp.l2_access_cycles,
+                         jnp.take_along_axis(ci.p_l2, owner, axis=0))
+    leg_ps = noc.unicast_ps(params.net_memory, home, owner,
+                            CTRL_BYTES, p_net_home,
+                            params.mesh_width, vnet=vp.net_memory) \
+        + l2_own_ps \
+        + noc.unicast_ps(params.net_memory, owner, home,
+                         params.line_size + CTRL_BYTES, p_net_own,
+                         params.mesh_width, vnet=vp.net_memory)
+    owner_ps = jnp.where(owner_leg, leg_ps, 0)
+    if fanout:
+        oh_fr = fan_go[None, :] & (
+            jnp.arange(KF, dtype=jnp.int32)[:, None]
+            == jnp.minimum(fan_rank, KF - 1)[None, :])
+
+        def fr_sel(vals):
+            return jnp.sum(jnp.where(oh_fr, vals[None, :], 0), axis=1,
+                           dtype=vals.dtype)
+
+        inv_words = jnp.sum(
+            jnp.where(oh_fr[:, :, None], act.inv_targets[None, :, :],
+                      jnp.uint64(0)), axis=1, dtype=jnp.uint64)
+        inv_bool = dirmod.bitmap_to_bool(inv_words, T)      # [KF, T]
+        home_fr = fr_sel(home)
+        pnh_fr = fr_sel(p_net_home.astype(jnp.int64)).astype(jnp.int32)
+        inv_ps_k = 2 * noc.max_hop_to_mask_ps(
+            params.net_memory, home_fr, inv_bool, CTRL_BYTES,
+            pnh_fr, params.mesh_width, vnet=vp.net_memory) \
+            + fr_sel(ack_ps)
+        inv_ps = jnp.where(fan_go, jnp.sum(
+            jnp.where(oh_fr, inv_ps_k[:, None], 0), axis=0), 0)
+        line_fr = fr_sel(line)
+        kcnt = jnp.sum(inv_bool, axis=1).astype(jnp.int64)  # [KF]
+        inv_count = jnp.where(fan_go, jnp.sum(
+            jnp.where(oh_fr, kcnt[:, None], 0), axis=0), 0)
+    else:
+        inv_bool = line_fr = None
+        inv_ps = jnp.zeros(T, dtype=jnp.int64)
+        inv_count = jnp.zeros(T, dtype=jnp.int64)
+    need_read = serve_all & act.dram_read
+    if shared_l2:
+        dsite = _dram_site(params, line)
+        local_ctl = home == dsite
+        to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
+            params.net_memory, home, dsite, CTRL_BYTES, p_net_home,
+            params.mesh_width, vnet=vp.net_memory))
+        from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
+            params.net_memory, dsite, home,
+            params.line_size + CTRL_BYTES,
+            jnp.take_along_axis(p_net, dsite, axis=0),
+            params.mesh_width, vnet=vp.net_memory))
+    else:
+        to_dram_ps = jnp.int64(0)
+        from_dram_ps = jnp.broadcast_to(jnp.int64(0), (T,))
+    dram_arrival = t_dir + owner_ps + to_dram_ps
+    dram_wb = act.dram_write & serve_all
+    l1_fill_ps = jnp.where(
+        is_if, _lat(vp.l1i_access_cycles, ci.p_l1i),
+        _lat(vp.l1d_access_cycles, ci.p_l1d))
+
+    # ---- sharer-bitmap delta + member bit-add guard (apply operands)
+    delta_sh = act.new_sharers - entry_row
+    req_word = (rows // 64).astype(jnp.int32)
+    req_bit = jnp.uint64(1) << (rows % 64).astype(jnp.uint64)
+    row_f = jnp.take_along_axis(
+        dsharers, way[:, None, None], axis=1)[:, 0, :]
+    own_w = jnp.take_along_axis(row_f, req_word[:, None],
+                                axis=1)[:, 0]
+    member_add = member & (~hit
+                           | ((own_w & req_bit) == jnp.uint64(0)))
+
+    # ---- queue-model-off tail: completion + the per-line floor write
+    # fold into the kernel (with the queue on, the loop-carried ring
+    # probe sits between dram_arrival and completion — the caller owns
+    # that stretch and the floor write).
+    if not params.dram.queue_model_enabled:
+        dram_start = jnp.where(need_read, dram_arrival, 0)
+        dram_ready = dram_start + vp.dram_latency_ps \
+            + vp.dram_processing_ps + from_dram_ps
+        t_data = jnp.maximum(t_dir + owner_ps,
+                             jnp.where(need_read, dram_ready, 0))
+        if fanout:
+            t_data = jnp.maximum(t_data, t_dir + inv_ps)
+        reply_done = t_data + reply_ps
+        if shared_l2:
+            completion = reply_done + l1_fill_ps + ci.extra
+        else:
+            completion = reply_done \
+                + _lat(vp.l2_access_cycles, ci.p_l2) + l1_fill_ps \
+                + ci.extra
+        tkey = t_data * T + rows
+        tmax_t = jnp.full((H,), -1, jnp.int64).at[
+            jnp.where(serve_all, hidx, H)].max(tkey, mode="drop")
+        fwin = serve_all & (tmax_t[hidx] == tkey)
+        ftbl = dense.stacked_set_table(hidx, fwin,
+                                       jnp.stack([line, t_data]),
+                                       ci.ftbl)
+    else:
+        completion = t_data = ftbl = None
+
+    return ChainOut(
+        way=way, hit=hit, serve=serve, serve_all=serve_all, member=member,
+        member_add=member_add, hard_stop=hard_stop, fan_go=fan_go,
+        owner_leg=owner_leg, evicting=evicting, owner=owner,
+        ow_slot=jnp.minimum(posr, J_OWN - 1), down_to=act.owner_downgrade_to,
+        new_state=act.new_state, new_owner=act.new_owner,
+        delta_sh=delta_sh, dram_read=act.dram_read,
+        dram_write=act.dram_write, need_read=need_read, dram_wb=dram_wb,
+        t_dir=t_dir, owner_ps=owner_ps, inv_ps=inv_ps, reply_ps=reply_ps,
+        from_dram_ps=from_dram_ps, dram_arrival=dram_arrival,
+        l1_fill_ps=l1_fill_ps, inv_bool=inv_bool, line_fr=line_fr,
+        inv_count=inv_count, completion=completion, t_data=t_data,
+        ftbl=ftbl,
+    )
+
+
+def _dram_site(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
+    """resolve.dram_site_of_line without importing resolve (no cycles):
+    the shared dense.home_fold over the controllers — one fold
+    definition, so the kernel's slice->controller timing legs can never
+    desynchronize from the caller's queue/counter site."""
+    return dense.home_fold(line, params.dram.num_controllers) \
+        * params.dram.controller_home_stride
+
+
+def run_chain(params: SimParams, vp: VariantParams, ci: ChainIn,
+              H: int, mode: str) -> ChainOut:
+    """Dispatch the classify: inline lax ('off') or one fused
+    pallas_call per replay iteration ('interpret' / 'tpu')."""
+    if mode == "off":
+        return chain_classify(params, vp, ci, H)
+    return dispatch.run_fused(
+        lambda ci2, vp2: chain_classify(params, vp2, ci2, H),
+        ci, vp, CHAIN_IN_AXES, ChainOut, CHAIN_OUT_AXES,
+        1, mode, "chain_classify")

@@ -1,0 +1,649 @@
+"""The block-window walk as a pure per-tile function + its fused kernel.
+
+``window_walk`` is engine/core._block_retire's hot loop — tag probes
+against every cache level, hit/stall/hazard classification over the
+[T, K] window, within-window branch-predictor RAW, the max-plus clock
+prefix, chain banking, LRU touch / fill application, and counter
+accumulation — extracted so ONE implementation serves both execution
+paths:
+
+  * the lax path calls it inline on full [T, ...] operands (the program
+    is op-for-op the pre-round-10 engine);
+  * the Pallas path (``run_window`` with mode 'interpret' / 'tpu') runs
+    the SAME function inside ``pl.pallas_call``, gridded over tile
+    blocks, so the K-deep walk's dozens of gathers, [T, K, K] mask
+    reductions, and scatter applies fuse into one kernel (one TPU
+    custom-call) over VMEM-resident operands.
+
+Every value in the walk is integer and per-tile independent (the only
+cross-tile effect of the window phase — the SPAWN landing scatter — is
+returned as (mask, child, time) triples and applied by the caller), so
+block-slicing the tile axis is exact and kernels-on is bit-identical to
+kernels-off by construction.  tests/test_kernels.py enforces it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from graphite_tpu.engine import cache as cachemod
+from graphite_tpu.engine import dense
+from graphite_tpu.engine import noc
+from graphite_tpu.engine.kernels import dispatch
+from graphite_tpu.engine.state import PEND_EX_REQ, PEND_IFETCH, PEND_SH_REQ
+from graphite_tpu.engine.vparams import VariantParams
+from graphite_tpu.events.schema import ICACHE_BYTES_PER_INSTRUCTION
+from graphite_tpu.isa import DVFSModule, EventOp
+from graphite_tpu.params import SimParams
+
+I, S, E, M = cachemod.I, cachemod.S, cachemod.E, cachemod.M
+
+
+def _lat(cycles, period_ps):
+    """cycles (int/array) at an integer ps clock period -> int64 ps."""
+    return jnp.asarray(cycles, jnp.int64) * jnp.asarray(period_ps, jnp.int64)
+
+
+class WindowIn(NamedTuple):
+    """Window-walk operands.  Tile-axis positions in WINDOW_IN_AXES;
+    fields whose machinery is compiled out of this config are None."""
+
+    meta: jnp.ndarray           # [3, T, K] int32 (op, arg, arg2)
+    addr: jnp.ndarray           # [T, K] int64
+    valid_ev: jnp.ndarray       # [T, K] bool (pos < N & tile_active)
+    tile_active: jnp.ndarray    # [T] bool
+    tile_ids: jnp.ndarray       # [T] int32 GLOBAL tile index (spawn src)
+    clock: jnp.ndarray          # [T] int64
+    period_ps: jnp.ndarray      # [T, NUM_DVFS_MODULES] int32
+    bp_table: jnp.ndarray       # [T, bp_size] bool
+    l1i_word: jnp.ndarray       # [A, T, sets] int64
+    l1i_rr: jnp.ndarray         # [T, sets] int32
+    l1d_word: jnp.ndarray
+    l1d_rr: jnp.ndarray
+    l2_word: Optional[jnp.ndarray]   # None under shared L2
+    l2_rr: Optional[jnp.ndarray]
+    boundary: jnp.ndarray       # [] int64
+    models_enabled: jnp.ndarray  # [] bool
+    stamp_base: jnp.ndarray     # [] int32 (round_ctr * STAMP_STRIDE)
+    # Miss-chain state (None at P == 0).
+    chain_rel: Optional[jnp.ndarray]  # [T] int64
+    mq_count: Optional[jnp.ndarray]   # [T] int32
+    mq_head: Optional[jnp.ndarray]    # [T] int32
+    mq_req: Optional[jnp.ndarray]     # [P, T] int64
+    mq_delta: Optional[jnp.ndarray]   # [P, T] int64
+    mq_extra: Optional[jnp.ndarray]   # [P, T] int64
+    # iocoom rings (None for simple cores; lax path only).
+    lq_ready: Optional[jnp.ndarray]   # [LQE, T] int64
+    sq_ready: Optional[jnp.ndarray]   # [SQE, T] int64
+
+
+WINDOW_IN_AXES = dict(
+    meta=1, addr=0, valid_ev=0, tile_active=0, tile_ids=0, clock=0,
+    period_ps=0, bp_table=0, l1i_word=1, l1i_rr=0, l1d_word=1, l1d_rr=0,
+    l2_word=1, l2_rr=0, boundary=None, models_enabled=None,
+    stamp_base=None, chain_rel=0, mq_count=0, mq_head=0, mq_req=1,
+    mq_delta=1, mq_extra=1, lq_ready=1, sq_ready=1,
+)
+
+
+# Counter increments, in the order ``ctr_inc`` rows are stacked.
+WINDOW_CTRS = (
+    "icount", "l1i_access", "l1i_miss", "l1d_read", "l1d_read_miss",
+    "l1d_write", "l1d_write_miss", "l2_access", "l2_miss", "branches",
+    "mispredicts", "spawns",
+)
+
+
+class WindowOut(NamedTuple):
+    clock: jnp.ndarray          # [T] int64
+    n_ret: jnp.ndarray          # [T] int32 events retired (cursor inc)
+    bp_table: jnp.ndarray       # [T, bp_size] bool
+    l1i_word: jnp.ndarray
+    l1i_rr: jnp.ndarray
+    l1d_word: jnp.ndarray
+    l1d_rr: jnp.ndarray
+    l2_word: Optional[jnp.ndarray]
+    l2_rr: Optional[jnp.ndarray]
+    ctr_inc: jnp.ndarray        # [len(WINDOW_CTRS), T] int64
+    spawn_mask: jnp.ndarray     # [T, K] bool (is_spawn & retired)
+    spawn_child: jnp.ndarray    # [T, K] int32 clipped stream id
+    spawn_land: jnp.ndarray     # [T, K] int64 landing time
+    chain_rel: Optional[jnp.ndarray]
+    mq_count: Optional[jnp.ndarray]
+    mq_req: Optional[jnp.ndarray]
+    mq_delta: Optional[jnp.ndarray]
+    mq_extra: Optional[jnp.ndarray]
+
+
+WINDOW_OUT_AXES = dict(
+    clock=0, n_ret=0, bp_table=0, l1i_word=1, l1i_rr=0, l1d_word=1,
+    l1d_rr=0, l2_word=1, l2_rr=0, ctr_inc=1, spawn_mask=0, spawn_child=0,
+    spawn_land=0, chain_rel=0, mq_count=0, mq_req=1, mq_delta=1,
+    mq_extra=1,
+)
+
+
+def _spanned_bound(params: SimParams, vp, boundary):
+    """Round-9 boundary-spanning bound (``tpu/fanout_replay``, effective
+    only at miss_chain > 0): the window, complex-slot, and cadence gates
+    all admit ONE QUANTUM of overrun past the cut — the same allowance
+    mid-chain tiles already get via ``rel < qps``, the same skew class
+    the lax model absorbs (the 2% chain-oracle gate bounds it).  Strict
+    at miss_chain == 0 (that engine is the bit-identity oracle) and with
+    the replay off (the round-8 cadence).  The ONE definition — core.py
+    aliases it, so the walk and the complex-slot/cadence gates can never
+    drift apart."""
+    if params.miss_chain > 0 and params.fanout_replay:
+        q = vp.quantum_ps if vp is not None \
+            else jnp.int64(params.quantum_ps)
+        return boundary + q
+    return boundary
+
+
+def window_walk(params: SimParams, vp: VariantParams, wi: WindowIn,
+                s_ids: int) -> WindowOut:
+    """Classify + retire one [TL, K] window (TL = full T on the lax
+    path, one tile block inside the kernel).  Pure: reads only ``wi``,
+    returns every effect.  The body is engine/core._block_retire's walk,
+    verbatim apart from the input plumbing — see that docstring for the
+    semantics commentary."""
+    K = params.block_events
+    TL = wi.clock.shape[0]               # LOCAL tile count (block size)
+    P = params.miss_chain
+    line_bits = params.line_size.bit_length() - 1
+    rows = jnp.arange(TL)
+    shared_l2 = params.shared_l2
+    mesi_local = params.protocol_kind == "sh_l2_mesi"
+    iocoom = params.core.model == "iocoom"
+
+    l1i = cachemod.CacheArrays(word=wi.l1i_word, rr_ptr=wi.l1i_rr)
+    l1d = cachemod.CacheArrays(word=wi.l1d_word, rr_ptr=wi.l1d_rr)
+    l2 = None if shared_l2 else cachemod.CacheArrays(word=wi.l2_word,
+                                                     rr_ptr=wi.l2_rr)
+
+    nm0 = wi.mq_count if P > 0 else jnp.zeros(TL, dtype=jnp.int32)
+    wbound = _spanned_bound(params, vp, wi.boundary)
+    tile_active = wi.tile_active
+    valid_ev = wi.valid_ev
+    meta, addr = wi.meta, wi.addr
+    op, arg, arg2 = meta[0], meta[1], meta[2]
+    op = jnp.where(valid_ev, op, EventOp.NOP)
+
+    en = wi.models_enabled            # scalar bool (flips are complex ops)
+
+    # ---- per-tile clock periods (DVFS-aware), ps per cycle
+    p_core = wi.period_ps[:, int(DVFSModule.CORE)][:, None]
+    p_l1i = wi.period_ps[:, int(DVFSModule.L1_ICACHE)][:, None]
+    p_l1d = wi.period_ps[:, int(DVFSModule.L1_DCACHE)][:, None]
+    p_l2 = wi.period_ps[:, int(DVFSModule.L2_CACHE)][:, None]
+    l1i_ps = _lat(vp.l1i_access_cycles, p_l1i)
+    l1d_ps = _lat(vp.l1d_access_cycles, p_l1d)
+    l2_ps = _lat(vp.l2_access_cycles, p_l2)
+    cycle_ps = _lat(1, p_core)
+
+    line = addr >> line_bits
+    is_comp = op == EventOp.COMPUTE
+    is_br = op == EventOp.BRANCH
+    is_rd = op == EventOp.MEM_READ
+    is_wr = op == EventOp.MEM_WRITE          # atomics stay complex
+    is_mem = is_rd | is_wr
+    is_stall = op == EventOp.STALL
+    is_sync = op == EventOp.SYNC
+    is_spawn = op == EventOp.SPAWN
+
+    # ---- probes against window-start state ([TL, K] block gathers)
+    pI = cachemod.probe(l1i, line, params.l1i.num_sets)
+    pD = cachemod.probe(l1d, line, params.l1d.num_sets)
+    if not shared_l2:
+        pL2 = cachemod.probe(l2, line, params.l2.num_sets)
+
+    writable = pD.state >= (E if mesi_local else M)
+    l1_ok = pD.hit & (is_rd | writable)
+    if shared_l2:
+        mem_l2 = jnp.zeros_like(l1_ok)
+        comp_l2 = jnp.zeros_like(l1_ok)
+    else:
+        mem_l2 = is_mem & ~l1_ok & pL2.hit & (is_rd | (pL2.state == M))
+        comp_l2 = is_comp & ~pI.hit & pL2.hit
+    mem_simple = is_mem & (l1_ok | mem_l2)
+    comp_simple = is_comp & (pI.hit | comp_l2)
+    if iocoom:
+        # Register-annotated events need the complex slot's RAW floors —
+        # decline them here (see core.py).  Lax path only: the kernel
+        # dispatch gates iocoom out.
+        annotated = (is_comp & ((arg2 >> 20) != 0)) \
+            | (is_rd & (((arg2 >> 8) & 31) != 0))
+        if params.core.mixed:
+            annotated = annotated \
+                & jnp.asarray(params.core.iocoom_mask)[:, None]
+        mem_simple = mem_simple & ~annotated
+        comp_simple = comp_simple & ~annotated
+    fill_d = mem_l2                           # L1D fill from local L2 hit
+    fill_i = comp_l2                          # L1I fill from local L2 hit
+
+    # Bankable misses — see core.py for the blocking-semantics notes.
+    if P > 0:
+        mem_bank0 = is_mem & ~l1_ok & ~mem_l2
+        comp_bank0 = is_comp & ~pI.hit & ~comp_l2
+    else:
+        mem_bank0 = jnp.zeros_like(l1_ok)
+        comp_bank0 = jnp.zeros_like(l1_ok)
+
+    if iocoom:
+        drain_t = jnp.maximum(jnp.max(wi.lq_ready, axis=0),
+                              jnp.max(wi.sq_ready, axis=0))[:, None]
+        drain_ev = is_spawn | is_sync \
+            | (is_br if not params.core.speculative_loads
+               else jnp.zeros_like(is_br))
+        if params.core.mixed:
+            drain_ev = drain_ev \
+                & jnp.asarray(params.core.iocoom_mask)[:, None]
+    else:
+        drain_ev = jnp.zeros_like(is_br)
+
+    ar = jnp.arange(K)
+    earlier = ar[None, :, None] > ar[None, None, :]           # [1, K, K]
+
+    # ---- chain forwarding (hit-on-pending-fill) — core.py notes.
+    wfwd = P > 0 and params.fanout_replay
+    if P > 0:
+        same_line_w = line[:, :, None] == line[:, None, :]    # [T, Kj, Ki]
+        fwd_win_d = (earlier & same_line_w & mem_bank0[:, None, :]
+                     & is_rd[:, :, None]).any(axis=2)
+        fwd_win_i = (earlier & same_line_w
+                     & comp_bank0[:, None, :]).any(axis=2)
+        # Pending elements banked in earlier rounds ([P, T] chain state).
+        slots_pc = jnp.arange(P, dtype=jnp.int32)[:, None]    # [P, 1]
+        pvalid = (slots_pc >= wi.mq_head[None, :]) \
+            & (slots_pc < wi.mq_count[None, :])               # [P, T]
+        pline = wi.mq_req >> 8
+        pkind = (wi.mq_req & 7).astype(jnp.int32)
+        p_is_if = pkind == PEND_IFETCH
+        pend_memT = (pvalid & ~p_is_if).T[:, None, :]         # [T, 1, P]
+        pend_ifT = (pvalid & p_is_if).T[:, None, :]
+        linematch_p = line[:, :, None] == pline.T[:, None, :]  # [T, K, P]
+        cover_pd = linematch_p & pend_memT & is_rd[:, :, None]
+        cover_pi = linematch_p & pend_ifT
+        if wfwd:
+            # Round-9 in-window write-over-EX-bank forwarding.
+            fwd_win_w = (earlier & same_line_w
+                         & (mem_bank0 & is_wr)[:, None, :]
+                         & is_wr[:, :, None]).any(axis=2)
+            fwd_win_d = fwd_win_d | fwd_win_w
+        fwd_pend_d = jnp.any(cover_pd, axis=2)
+        fwd_pend_i = jnp.any(cover_pi, axis=2)
+        mem_fwd = mem_bank0 & (fwd_win_d | fwd_pend_d)
+        comp_fwd = comp_bank0 & (fwd_win_i | fwd_pend_i)
+    else:
+        mem_fwd = comp_fwd = jnp.zeros_like(l1_ok)
+    mem_bank = mem_bank0 & ~mem_fwd
+    comp_bank = comp_bank0 & ~comp_fwd
+    mem_simple = mem_simple | mem_fwd
+    comp_simple = comp_simple | comp_fwd
+    fill_bank_d = mem_bank                    # future L1D fill (hazards)
+    fill_bank_i = comp_bank                   # future L1I fill
+
+    # ---- fill hazards (see core.py for the staleness rules)
+
+    def _hazard(fills, accesses, set_idx):
+        """accesses[j] unsafe if exists i<j with fills[i] & same set."""
+        same = set_idx[:, :, None] == set_idx[:, None, :]     # [T, Kj, Ki]
+        return accesses & (earlier & same & fills[:, None, :]).any(axis=2)
+
+    touch_d = is_mem & l1_ok
+    touch_i = is_comp & pI.hit
+    upg_d = touch_d & is_wr & (pD.state == E) if mesi_local \
+        else jnp.zeros_like(touch_d)
+    haz_d = _hazard(fill_d | upg_d, is_mem, pD.set_idx) \
+        | _hazard(touch_d | fill_d, fill_d, pD.set_idx)
+    haz_i = _hazard(fill_i, is_comp, pI.set_idx) \
+        | _hazard(touch_i | fill_i, fill_i, pI.set_idx)
+    if P > 0 and shared_l2:
+        ssD = pD.set_idx[:, :, None] == pD.set_idx[:, None, :]
+        haz_d = haz_d | (is_mem & (
+            earlier & ssD & ~same_line_w
+            & fill_bank_d[:, None, :]).any(axis=2))
+        ssI = pI.set_idx[:, :, None] == pI.set_idx[:, None, :]
+        haz_i = haz_i | (is_comp & (
+            earlier & ssI & ~same_line_w
+            & fill_bank_i[:, None, :]).any(axis=2))
+    if P > 0:
+        bank_w_uncov = (mem_bank0 & ~is_wr) if wfwd else mem_bank0
+        uncov_w = earlier & same_line_w & (
+            (is_mem[:, :, None] & comp_bank0[:, None, :])
+            | (is_wr[:, :, None] & bank_w_uncov[:, None, :])
+            | (is_comp[:, :, None] & mem_bank0[:, None, :]))
+        hazard_uncov = uncov_w.any(axis=2)
+        haz_d = haz_d | (is_mem & hazard_uncov)
+        haz_i = haz_i | (is_comp & hazard_uncov)
+    hazard = haz_d | haz_i
+
+    # Banked-miss L2 hazards (private) — core.py notes.
+    l2_fill_cand = mem_bank | comp_bank
+    if P > 0 and not shared_l2:
+        l2ss = pL2.set_idx[:, :, None] == pL2.set_idx[:, None, :]
+        l2_cover = same_line_w & (
+            (is_mem[:, :, None] & mem_bank0[:, None, :]
+             & is_rd[:, :, None])
+            | (is_comp[:, :, None] & comp_bank0[:, None, :]))
+        if wfwd:
+            l2_cover = l2_cover | (
+                same_line_w & is_wr[:, :, None]
+                & (mem_bank0 & is_wr)[:, None, :])
+        hazard = hazard | ((is_mem | is_comp) & (
+            earlier & l2ss & ~l2_cover
+            & l2_fill_cand[:, None, :]).any(axis=2))
+
+    # Pending-chain hazards (stall-on-use across rounds) — core.py.
+    if P > 0:
+        pvT0 = pvalid.T[:, None, :]
+        haz_pend = (is_mem & jnp.any(
+            linematch_p & pvT0 & ~cover_pd, axis=2)) \
+            | (is_comp & jnp.any(
+                linematch_p & pvT0 & ~cover_pi, axis=2))
+        if shared_l2:
+            pd_set = cachemod.set_index(pline, params.l1d.num_sets).T
+            pi_set = cachemod.set_index(pline, params.l1i.num_sets).T
+            haz_pend = haz_pend | (is_mem & jnp.any(
+                pend_memT & ~cover_pd
+                & (pD.set_idx[:, :, None] == pd_set[:, None, :]), axis=2)) \
+                | (is_comp & jnp.any(
+                    pend_ifT & ~cover_pi
+                    & (pI.set_idx[:, :, None] == pi_set[:, None, :]),
+                    axis=2))
+        else:
+            p2_set = cachemod.set_index(pline, params.l2.num_sets).T
+            pvT = pvalid.T[:, None, :]
+            haz_pend = haz_pend | ((is_mem | is_comp) & jnp.any(
+                pvT & ~(cover_pd | cover_pi)
+                & (pL2.set_idx[:, :, None] == p2_set[:, None, :]),
+                axis=2))
+        hazard = hazard | haz_pend
+
+    # Retire classes — core.py notes.
+    br_abs = iocoom and not params.core.speculative_loads
+    if br_abs and params.core.mixed:
+        _iot_w = jnp.asarray(params.core.iocoom_mask)[:, None]
+        br_rel = is_br & ~_iot_w
+        br_drain = is_br & _iot_w
+    elif br_abs:
+        br_rel = jnp.zeros_like(is_br)
+        br_drain = is_br
+    else:
+        br_rel = is_br
+        br_drain = jnp.zeros_like(is_br)
+    base_ok = valid_ev & ~hazard & en
+    ok_rel = (comp_simple | mem_simple | br_rel) & base_ok
+    ok_abs = (is_stall | is_sync | is_spawn | br_drain) & base_ok
+    ok_bank = (mem_bank | comp_bank) & base_ok
+    ok = ok_rel | ok_abs | ok_bank            # retire-capable (BP masking)
+
+    # ---- branch predictor: within-window read-after-write on table slots
+    if params.core.bp_type == "none":
+        correct = jnp.ones_like(is_br)
+        bidx = None
+    else:
+        bidx = (addr % params.core.bp_size).astype(jnp.int32)
+        tbl_pred = jnp.take_along_axis(wi.bp_table, bidx, axis=1)
+        same_slot = bidx[:, :, None] == bidx[:, None, :]      # [T, Kj, Ki]
+        taken = arg != 0
+        w_mask = earlier & same_slot & (is_br & ok)[:, None, :]  # [T,Kj,Ki]
+        has_w = w_mask.any(axis=2)
+        last_w = jnp.argmax(
+            jnp.where(w_mask, ar[None, None, :], -1), axis=2)
+        pred_blk = jnp.take_along_axis(taken, last_w, axis=1)
+        pred = jnp.where(has_w, pred_blk, tbl_pred)
+        correct = pred == taken
+
+    # ---- per-event dt (int64 ps) and clock floors
+    icount_ev = jnp.maximum(arg2 & ((1 << 20) - 1), 0).astype(jnp.int64)
+    n_lines = jnp.maximum(
+        (icount_ev * ICACHE_BYTES_PER_INSTRUCTION + params.line_size - 1)
+        // params.line_size, 1)
+    cost_ps = _lat(jnp.maximum(arg, 0), p_core)
+    fetch_ps = icount_ev * l1i_ps
+    dt_comp = cost_ps + fetch_ps \
+        + jnp.where(comp_l2, n_lines * l2_ps, 0)
+    dt_br = jnp.where(correct, cycle_ps,
+                      _lat(vp.bp_mispredict_penalty, p_core)) \
+        + l1i_ps
+    dt_mem = jnp.where(mem_l2, l1d_ps + l2_ps, l1d_ps)
+    dt_spawn = _lat(jnp.maximum(arg, 0), p_core)
+    dt = jnp.zeros((TL, K), dtype=jnp.int64)
+    dt = jnp.where(is_comp, dt_comp, dt)
+    dt = jnp.where(is_br, dt_br, dt)
+    dt = jnp.where(is_mem, dt_mem, dt)
+    dt = jnp.where(is_sync, cost_ps, dt)
+    dt = jnp.where(en, dt, jnp.where(is_sync, cost_ps, 0))
+    dt = jnp.where(is_spawn, dt_spawn, dt)
+    NEGF = jnp.int64(-(2**62))
+    floor = jnp.where(is_stall | is_sync, addr, NEGF)
+    if iocoom:
+        floor = jnp.where(drain_ev, jnp.maximum(floor, drain_t), floor)
+
+    # ---- max-plus prefix (see core.py for the chain-banking notes)
+    qps = vp.quantum_ps
+    miss_tags_ps = cycle_ps if shared_l2 else \
+        _lat(vp.l2_tags_access_cycles, p_l2)
+    issue_off = jnp.where(is_comp, l1i_ps, l1d_ps) + miss_tags_ps
+    clk = wi.clock
+    rel = wi.chain_rel if P > 0 else jnp.zeros(TL, dtype=jnp.int64)
+    nm = nm0
+    n_ret = jnp.zeros(TL, dtype=jnp.int32)
+    run = tile_active
+    clks = []
+    bank_marks, bank_slots, bank_deltas = [], [], []
+    for j in range(K):
+        clks.append(clk)                     # clock BEFORE event j
+        if P > 0:
+            bank_j = ok_bank[:, j] & (nm < P)
+            okj = ok_rel[:, j] | (ok_abs[:, j] & (nm == 0)) | bank_j
+            in_b = jnp.where(nm == 0, clk < wbound,
+                             (rel < qps) & (nm < P))
+        else:
+            bank_j = jnp.zeros(TL, dtype=bool)
+            okj = ok_rel[:, j] | ok_abs[:, j]
+            in_b = clk < wi.boundary
+        can = run & okj & in_b
+        bankc = can & bank_j
+        if P > 0:
+            bank_marks.append(bankc)
+            bank_slots.append(nm)
+            bank_deltas.append(
+                jnp.where(nm == 0, clk, rel) + issue_off[:, j])
+            abs_step = can & (nm == 0) & ~bankc
+            rel_step = can & (nm > 0) & ~bankc
+            rel = jnp.where(bankc, 0,
+                            jnp.where(rel_step, rel + dt[:, j], rel))
+            nm = nm + bankc.astype(jnp.int32)
+        else:
+            abs_step = can
+        clk = jnp.where(abs_step,
+                        jnp.maximum(clk, floor[:, j]) + dt[:, j], clk)
+        n_ret = n_ret + can.astype(jnp.int32)
+        run = can
+    clk_before = jnp.stack(clks, axis=1)                      # [T, K]
+    retired = ar[None, :] < n_ret[:, None]                    # [T, K]
+
+    # ---- SPAWN landing times (the cross-tile scatter itself is the
+    # caller's: spawned_at.at[child].max(spawn_land) over these masks).
+    child = jnp.clip(arg2, 0, s_ids - 1)
+    spawn_base = jnp.maximum(clk_before, floor) if iocoom else clk_before
+    spawn_land = spawn_base + dt_spawn + noc.unicast_ps(
+        params.net_user,
+        jnp.broadcast_to(wi.tile_ids[:, None], (TL, K)),
+        child % params.num_tiles, 8,
+        wi.period_ps[:, int(DVFSModule.NETWORK_USER)][:, None],
+        params.mesh_width, vnet=vp.net_user)
+    spawn_mask = is_spawn & retired
+
+    # ---- apply cache effects (stamps encode within-window order)
+    stamp = (wi.stamp_base + ar)[None, :]
+    enb = jnp.broadcast_to(jnp.asarray(en), (TL, K))
+    l1i = cachemod.touch(l1i, pI.set_idx, pI.way,
+                         touch_i & retired & enb,
+                         _row_word(pI.row, pI.way), stamp)
+    d_word = _row_word(pD.row, pD.way)
+    if mesi_local:
+        d_word = cachemod.with_state(
+            d_word, jnp.where(is_wr & (pD.state == E), M, pD.state))
+    l1d = cachemod.touch(l1d, pD.set_idx, pD.way,
+                         touch_d & retired & enb, d_word, stamp)
+    if not shared_l2:
+        l2 = cachemod.touch(l2, pL2.set_idx, pL2.way,
+                            (mem_l2 | comp_l2) & retired & enb,
+                            _row_word(pL2.row, pL2.way), stamp)
+
+    # Window fills — see core.py _apply_fills commentary.
+    def _apply_fills(cache, fills, probe, fill_state, cp):
+        act = fills & retired & enb
+        st_row = cachemod.word_state(probe.row)       # [A, T, K]
+        invalid = st_row == cachemod.I
+        has_inv = invalid.any(axis=0)
+        first_inv = jnp.argmax(invalid, axis=0)
+        lru_way = jnp.argmin(cachemod.word_stamp(probe.row), axis=0)
+        vic_way = jnp.where(has_inv, first_inv, lru_way)
+        fway = jnp.where(probe.hit, probe.way,
+                         vic_way).astype(jnp.int32)
+        new_word = cachemod.pack_word(
+            line.astype(jnp.int32), stamp, fill_state)
+        if cp.replacement == "round_robin":
+            adv = act & ~probe.hit
+            rr = jnp.take_along_axis(cache.rr_ptr, probe.set_idx,
+                                     axis=1)
+            A = cache.word.shape[0]
+            fway = jnp.where(probe.hit, probe.way,
+                             jnp.where(has_inv, first_inv, rr % A))
+            cache = cache._replace(rr_ptr=cache.rr_ptr.at[
+                jnp.where(adv, rows[:, None], TL), probe.set_idx].set(
+                (rr + 1) % A, mode="drop"))
+        vic_word = _row_word(probe.row, fway)
+        vic_tag = cachemod.word_tag(vic_word).astype(jnp.int64)
+        vic_state = jnp.where(probe.hit, I, cachemod.word_state(vic_word))
+        cache = cache._replace(word=cache.word.at[
+            fway, jnp.where(act, rows[:, None], TL), probe.set_idx].set(
+            new_word, mode="drop"))
+        return cache, vic_tag, vic_state
+
+    if not shared_l2:
+        l1d, _, _ = _apply_fills(
+            l1d, fill_d, pD,
+            jnp.where(is_wr, M, S).astype(jnp.int32), params.l1d)
+        l1i, _, _ = _apply_fills(
+            l1i, fill_i, pI,
+            jnp.full((TL, K), S, dtype=jnp.int32), params.l1i)
+
+    # ---- branch-predictor table: last retired write per slot wins
+    bp_table = wi.bp_table
+    if bidx is not None:
+        wr_ev = is_br & retired & enb
+        later_same = (earlier.transpose(0, 2, 1) & same_slot
+                      & wr_ev[:, None, :]).any(axis=2)
+        winner = wr_ev & ~later_same
+        SZ = params.core.bp_size
+        if params.num_tiles * K * SZ <= dense.DENSE_MAX_ELEMS:
+            # Dense masked update vs scatter: the branch keys on the
+            # GLOBAL T (both forms give identical values — one winner
+            # per slot — so the lax and blocked paths always agree).
+            oh = (bidx[:, :, None]
+                  == jnp.arange(SZ, dtype=jnp.int32)[None, None, :]) \
+                & winner[:, :, None]
+            wrote = oh.any(axis=1)
+            val = (oh & taken[:, :, None]).any(axis=1)
+            bp_table = jnp.where(wrote, val, bp_table)
+        else:
+            bp_table = bp_table.at[
+                rows[:, None], jnp.where(winner, bidx, SZ)
+            ].set(taken, mode="drop")
+
+    # ---- counters
+
+    def msum(mask, val=1):
+        v = jnp.asarray(val)
+        v = jnp.broadcast_to(v, (TL, K)) if v.ndim < 2 else v
+        return jnp.sum(jnp.where(mask & retired & enb, v.astype(jnp.int64),
+                                 0), axis=1)
+
+    zero = jnp.zeros(TL, dtype=jnp.int64)
+    ctr_inc = jnp.stack([
+        msum(is_comp, icount_ev)
+        + msum((is_mem & ((arg2 & 0xFF) == 0)) | is_br),     # icount
+        msum(is_comp, icount_ev) + msum(is_br),              # l1i_access
+        msum(is_comp & ~pI.hit & ~comp_fwd, n_lines),        # l1i_miss
+        msum(is_rd),                                         # l1d_read
+        msum(is_rd & ~l1_ok & ~mem_fwd),                     # l1d_read_miss
+        msum(is_wr),                                         # l1d_write
+        msum(is_wr & ~l1_ok & ~mem_fwd),                     # l1d_write_miss
+        zero if shared_l2
+        else msum(mem_l2 | comp_l2 | l2_fill_cand),          # l2_access
+        zero if shared_l2 else msum(l2_fill_cand),           # l2_miss
+        msum(is_br),                                         # branches
+        msum(is_br & ~correct),                              # mispredicts
+        msum(is_spawn),                                      # spawns
+    ])
+
+    # ---- record banked chain elements ([T, K] window results -> the
+    # [P, T] chain arrays, via a dense slot one-hot — no scatter ops).
+    if P > 0:
+        bank_mark = jnp.stack(bank_marks, axis=1)    # [T, K]
+        bank_slot = jnp.stack(bank_slots, axis=1)
+        bank_delta = jnp.stack(bank_deltas, axis=1)
+        kind_ev = jnp.where(is_comp, PEND_IFETCH,
+                            jnp.where(is_wr, PEND_EX_REQ, PEND_SH_REQ))
+        req_val = kind_ev.astype(jnp.int64) | (line << 8)
+        extra_val = jnp.where(
+            is_comp,
+            cost_ps + fetch_ps
+            + (0 if shared_l2 else (n_lines - 1) * l2_ps),
+            jnp.int64(0))
+        slot_oh = (bank_slot[None] == jnp.arange(P)[:, None, None]) \
+            & bank_mark[None]                        # [P, T, K]
+        anyb = slot_oh.any(axis=2)
+
+        def put(dst, val):
+            v = jnp.sum(jnp.where(slot_oh, val[None], 0),
+                        axis=2).astype(dst.dtype)
+            return jnp.where(anyb, v, dst)
+
+        mq_req = put(wi.mq_req, req_val)
+        mq_delta = put(wi.mq_delta, bank_delta)
+        mq_extra = put(wi.mq_extra, extra_val)
+        mq_count = nm
+        chain_rel = jnp.where(nm > 0, rel, 0)
+    else:
+        mq_req = mq_delta = mq_extra = mq_count = chain_rel = None
+
+    return WindowOut(
+        clock=clk, n_ret=n_ret, bp_table=bp_table,
+        l1i_word=l1i.word, l1i_rr=l1i.rr_ptr,
+        l1d_word=l1d.word, l1d_rr=l1d.rr_ptr,
+        l2_word=None if shared_l2 else l2.word,
+        l2_rr=None if shared_l2 else l2.rr_ptr,
+        ctr_inc=ctr_inc,
+        spawn_mask=spawn_mask, spawn_child=child.astype(jnp.int32),
+        spawn_land=spawn_land,
+        chain_rel=chain_rel, mq_count=mq_count,
+        mq_req=mq_req, mq_delta=mq_delta, mq_extra=mq_extra,
+    )
+
+
+def _row_word(row: jnp.ndarray, way: jnp.ndarray) -> jnp.ndarray:
+    """[A, ...] gathered set row x [...] way -> [...] line word."""
+    return jnp.take_along_axis(row, way[None], axis=0)[0]
+
+
+# ---------------------------------------------------- pallas dispatch
+
+def run_window(params: SimParams, vp: VariantParams, wi: WindowIn,
+               s_ids: int, mode: str) -> WindowOut:
+    """Dispatch the walk: inline lax ('off') or one fused pallas_call
+    gridded over tile blocks ('interpret' / 'tpu')."""
+    if mode == "off":
+        return window_walk(params, vp, wi, s_ids)
+    return dispatch.run_fused(
+        lambda wi2, vp2: window_walk(params, vp2, wi2, s_ids),
+        wi, vp, WINDOW_IN_AXES, WindowOut, WINDOW_OUT_AXES,
+        params.num_tiles, mode, "window_walk")

@@ -32,6 +32,8 @@ from graphite_tpu.engine import noc
 from graphite_tpu.engine import noc_flight
 from graphite_tpu.engine import queue_models
 from graphite_tpu.engine.core import STAMP_STRIDE, _lat, _period, mcp_tile
+from graphite_tpu.engine.kernels import chain as kchain
+from graphite_tpu.engine.kernels import dispatch as kdispatch
 from graphite_tpu.engine.state import (
     PEND_BARRIER, PEND_CBC, PEND_COND, PEND_CSIG, PEND_EX_REQ, PEND_IFETCH,
     PEND_JOIN, PEND_MUTEX, PEND_NONE, PEND_RECV, PEND_SEND, PEND_SH_REQ,
@@ -44,28 +46,21 @@ from graphite_tpu.params import SimParams
 I, S, O, E, M = (cachemod.I, cachemod.S, cachemod.O, cachemod.E,
                  cachemod.M)
 
-# Control-message payload bytes (request/inv/ack packets; reference
-# ShmemMsg header, shmem_msg.h:12-29).
-CTRL_BYTES = 8
-
-# Per-target budget of point-to-point owner flush/downgrade deliveries per
-# conflict round (several requesters may name one owner tile); overflow
-# rows defer a round.
-J_OWN = 8
+# Control-message payload bytes + per-target owner-delivery budget —
+# shared with the chain classify kernel (round 10 moved the definitions
+# to engine/kernels/chain.py; reference ShmemMsg header,
+# shmem_msg.h:12-29).
+CTRL_BYTES = kchain.CTRL_BYTES
+J_OWN = kchain.J_OWN
 
 
-def _home_fold(line: jnp.ndarray, n: int) -> jnp.ndarray:
-    """Line -> home slot in [0, n): round-robin over consecutive lines
-    (streams still spread like the reference's low-bit interleaving,
-    address_home_lookup.cc), but with the bits above the slot index
-    XOR-folded in first — a plain ``line % n`` sends every
-    power-of-two-strided per-tile region (e.g. tile t's block at
-    base + t * 2^k) to ONE home, serializing all T cold misses through a
-    single directory set's way election (observed: 1024 tiles, 98k
-    deferrals, one 48 us DRAM horizon)."""
-    bits = max(n.bit_length() - 1, 1)
-    x = line ^ (line >> bits) ^ (line >> (2 * bits)) ^ (line >> (3 * bits))
-    return (x % n).astype(jnp.int32)
+# Line -> home-slot fold: ONE definition in dense.py (round 10 — the
+# chain classify kernel's slice->controller legs use it too; streams
+# still spread like the reference's low-bit interleaving,
+# address_home_lookup.cc, but a plain ``line % n`` sends every
+# power-of-two-strided per-tile region to ONE home — observed: 1024
+# tiles, 98k deferrals, one 48 us DRAM horizon).
+_home_fold = dense.home_fold
 
 
 def home_of_line(params: SimParams, line: jnp.ndarray) -> jnp.ndarray:
@@ -119,61 +114,12 @@ _binsum = dense.binsum
 _DENSE_MAX_ELEMS = dense.DENSE_MAX_ELEMS
 
 
-def _fcfs_keys(active, issue) -> jnp.ndarray:
-    """Per-row FCFS key ordered by (issue, tile), unique per row.
-
-    Issue times are rebased to the earliest active row so the key stays far
-    below the ``_BIG`` empty-slot sentinel at any simulated time (skew
-    within one resolve pass is bounded by quantum + max latency, nowhere
-    near the 2^40 clip).
-    """
-    T = issue.shape[0]
-    rows = jnp.arange(T)
-    issue0 = jnp.min(jnp.where(active, issue, _BIG))
-    return jnp.clip(issue - issue0, 0, jnp.int64(2**40)) * T + rows
-
-
-def _elect(active, packed, idx, size):
-    """Min-FCFS election: the earliest active row per ``idx`` value wins
-    (one winner per table slot; a hash collision between two distinct keys
-    mapping to one slot only defers the later row).
-
-    Dense [R, size] mask form when it fits; scatter-min table above the
-    size cap (large T), where the serialized scatter is amortized anyway.
-    """
-    R = packed.shape[0]
-    if R * size <= _DENSE_MAX_ELEMS:
-        oh = _oh(idx, size)
-        tbl = jnp.min(jnp.where(oh & active[:, None], packed[:, None], _BIG),
-                      axis=0)
-        return active & (_sel(oh, tbl) == packed)
-    tbl = jnp.full((size,), _BIG, dtype=jnp.int64).at[
-        jnp.where(active, idx, size)].min(packed, mode="drop")
-    return active & (tbl[idx] == packed)
-
-
-def _grouped_rank(group: jnp.ndarray, key: jnp.ndarray,
-                  active: jnp.ndarray) -> jnp.ndarray:
-    """FCFS rank of each active row within its ``group``, ordered by
-    ``key``, as ONE dense [R, R] masked compare-and-sum.
-
-    Deliberately dense: [R, R] bool work is a few MB of fused vector ops
-    even at R = 2048, while sort-based ranking lowers to a serialized
-    while-loop of dynamic-update-slices on TPU (profiled at ~31 ms per
-    [2T] lexsort at T = 1024 — the round-3 engine's dominant cost until
-    replaced).  Key ties break by row index (the owner-delivery caller
-    duplicates its FCFS keys across two delivery legs, which may share a
-    target tile — without the tiebreak they'd collide on one slot).
-    Inactive rows get rank 0.
-    """
-    R = key.shape[0]
-    idx = jnp.arange(R, dtype=jnp.int32)
-    g = group.astype(jnp.int32)
-    before = (g[None, :] == g[:, None]) \
-        & ((key[None, :] < key[:, None])
-           | ((key[None, :] == key[:, None]) & (idx[None, :] < idx[:, None]))) \
-        & active[None, :] & active[:, None]
-    return jnp.sum(before, axis=1, dtype=jnp.int32)
+# FCFS election helpers — moved to engine/dense.py (round 10) so the
+# chain classify kernel (engine/kernels/chain.py) and these conflict
+# rounds share ONE implementation; aliased here for the round loop.
+_fcfs_keys = dense.fcfs_keys
+_elect = dense.elect
+_grouped_rank = dense.grouped_rank
 
 
 def _unblock(state: SimState, mask, completion, sync: bool) -> SimState:
@@ -288,7 +234,8 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
     p_l1d = _period(state, DVFSModule.L1_DCACHE)
     p_l1i = _period(state, DVFSModule.L1_ICACHE)
     p_core = _period(state, DVFSModule.CORE)
-    ack_ps = _lat(vp.inv_ack_cycles, p_core)
+    # (ack-combining cost is priced inside the classify kernel now —
+    # chain_classify derives it from p_core itself)
     dram_access_ps = vp.dram_latency_ps
     dram_service_ps = vp.dram_processing_ps
     flits_req = noc.num_flits(CTRL_BYTES, vp.net_memory.flit_width_bits)
@@ -299,12 +246,19 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
     def slot_body(p, carry):
         # Each iteration serves every tile's CURRENT head (not the
         # static slot p): an election loser retries the same element
-        # next iteration while the winner's chain moves on — lockstep
-        # tiles banking one boundary line at the same chain position
-        # lose one iteration instead of their whole tail, and the
-        # per-iteration FCFS election keeps in-pass service in issue
-        # order.  P iterations serve up to P elements per tile — the
-        # whole bank when nothing collides.
+        # next iteration while the winner's chain moves on.  P
+        # iterations serve up to P elements per tile — the whole bank
+        # when nothing collides.
+        #
+        # Round-10 shape: the head gathers and the directory row
+        # gathers stay here; the classify/elect/combine/price sub-chain
+        # — victim-way tables, the (home, dset, way) FCFS election,
+        # fan-out/owner budgets, SH combining, the zero-load timing
+        # legs — runs through engine/kernels/chain.py (inline lax with
+        # tpu/pallas_kernels off, ONE fused Pallas kernel per iteration
+        # otherwise, bit-identically); the loop-carried DRAM queue
+        # probe and the apply scatters stay here.  See chain_classify
+        # for the transplanted commentary.
         del p
         state, stopped, head, base, ftbl = carry
         hsel = jnp.clip(head, 0, max(P - 1, 0))[None, :]
@@ -325,299 +279,109 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
         issue = base + delta
         hidx = (dense.fmix64(line) % jnp.uint64(H)).astype(jnp.int32)
 
-        # ---- directory probe at (home, dset) — post-predecessor state
+        # ---- directory entry rows at (home, dset) — ONE gather each
         drow = state.dir_word[:, fidx].T                       # [T, A]
-        dstate = dword_state(drow)
-        dstamp = dword_stamp(drow)
-        match = (dword_tag(drow) == line[:, None].astype(jnp.int32)) \
-            & (dstate != I)
-        hit = match.any(axis=1) & active
-        hway = jnp.argmax(match, axis=1).astype(jnp.int32)
-        invalid = dstate == I
-
-        # ---- victim way for allocs: invalid first, then stamp-LRU,
-        # ways held by this slot's hit elements excluded (hash table on
-        # the flat set id; a collision only stops a chain early)
-        fhash = (dense.fmix64(fidx.astype(jnp.int64))
-                 % jnp.uint64(H)).astype(jnp.int32)
-        used_tbl = jnp.zeros((H, A), dtype=bool).at[
-            jnp.where(hit, fhash, H), hway].set(True, mode="drop")
-        hway_used = used_tbl[fhash]                            # [T, A]
-        NEVER = jnp.int32(2**31 - 1)
-        vkey = jnp.where(hway_used, NEVER,
-                         jnp.where(invalid, -1, dstamp))
-        miss_way = jnp.argmin(vkey, axis=1).astype(jnp.int32)
-        can_alloc = active & ~hit & (jnp.take_along_axis(
-            vkey, miss_way[:, None], axis=1)[:, 0] != NEVER)
-        way = jnp.where(hit, hway, miss_way)
-
-        # ---- way-slot election: same-(home, dset) allocs in one slot
-        # pick the same victim way; the later element (FCFS by issue)
-        # stops its chain and retries through the round loop.
-        am = (home.astype(jnp.int64) * ndsets + dset) * A + way
-        aidx = (dense.fmix64(am) % jnp.uint64(H)).astype(jnp.int32)
-        packed = _fcfs_keys(active, issue)
-        wslot = _elect(active, packed, aidx, H)
-
-        # ---- transition against the replayed entry
-        way_word = jnp.take_along_axis(drow, way[:, None], axis=1)[:, 0]
-        way_state = dword_state(way_word)
-        way_owner = dword_owner(way_word)
         dsharers = state.dir_sharers[:, fidx].reshape(
             W, A, T).transpose(2, 1, 0)                        # [T, A, W]
-        entry_row = jnp.take_along_axis(
-            dsharers, way[:, None, None], axis=1)[:, 0, :]    # [T, W]
-        entry_state = jnp.where(hit, way_state, I)
-        entry_owner = jnp.where(hit, way_owner, -1)
-        entry_sharers = jnp.where(hit[:, None], entry_row,
-                                  jnp.zeros((T, W), dtype=jnp.uint64))
-        act = dirmod.transition(params.protocol_kind, is_ex, rows,
-                                entry_state, entry_owner, entry_sharers,
-                                W, is_ifetch=is_if)
-        has_inv = (act.inv_targets != jnp.uint64(0)).any(axis=1)
-        # Directory-victim entry must need no traffic (I, or S/O with an
-        # empty sharer bitmap) — live entries take the round loop's
-        # budgeted invalidation machinery.
-        vic_dead = (way_state == I) \
-            | (((way_state == S) | (way_state == O))
-               & (entry_row == jnp.uint64(0)).all(axis=1))
-        cand0 = active & wslot & (hit | (can_alloc & vic_dead))
-        if fanout:
-            # Fan-out heads join the serve set through a KF-per-iteration
-            # FCFS budget (the round loop's fan-out budget semantics, per
-            # replay iteration); a budget loser keeps its chain alive and
-            # retries next iteration.
-            need_fan = cand0 & has_inv
-            fan_rank = jnp.sum(
-                (packed[None, :] < packed[:, None]) & need_fan[None, :]
-                & need_fan[:, None], axis=1, dtype=jnp.int32)
-            fan_sel = need_fan & (fan_rank < KF)
-            cand = cand0 & (~has_inv | fan_sel)
-        else:
-            fan_rank = jnp.zeros(T, dtype=jnp.int32)
-            cand = cand0 & ~has_inv
-        # Owner flush/downgrade legs serve here with the round loop's
-        # J_OWN per-target delivery budget (several requesters may name
-        # one owner tile); over-budget rows stop their chain instead.
-        owner = act.owner_tile
-        posr = _grouped_rank(owner, packed, cand & act.owner_leg)
-        serve = cand & ~(act.owner_leg & (posr >= J_OWN))
-        owner_leg = act.owner_leg & serve
-        fan_go = serve & has_inv          # in-pass fan-out serves
-        evicting = serve & ~hit & (way_state != I)
 
-        # ---- SH combining within the slot (the round loop's combining,
-        # full_map): same-slot same-line SH requests against an I/S
-        # entry all lost the way election to their rep — serving them
-        # BESIDE it, each priced with its own un-floored trip, is
-        # exactly how the oracle's conflict round prices the group;
-        # bouncing them to the round loop alone made every follower
-        # wait out the rep's whole service through the serialization
-        # floor (measured 7% slow on the shared-readers probe).
-        sh_ok_e = (entry_state == I) | (entry_state == S)
-        if shared_l2:
-            sh_ok_e = sh_ok_e & (entry_state != I)
-        ex_any_t = jnp.zeros((H,), dtype=bool).at[
-            jnp.where(active & is_ex, hidx, H)].set(True, mode="drop")
-        rep_sh = serve & ~is_ex & sh_ok_e
-        rep_line_t = jnp.full((H,), -1, jnp.int64).at[
-            jnp.where(rep_sh, hidx, H)].set(line, mode="drop")
-        rep_way_t = jnp.zeros((H,), jnp.int32).at[
-            jnp.where(rep_sh, hidx, H)].set(way, mode="drop")
-        member = active & ~serve & ~is_ex & sh_ok_e & ~ex_any_t[hidx] \
-            & (rep_line_t[hidx] == line)
-        way = jnp.where(member, rep_way_t[hidx], way)
-        serve_all = serve | member
-        # Only transitions needing the round loop's machinery STOP a
-        # chain (live directory victims, owner delivery-budget overflow
-        # — and invalidation fan-outs only with tpu/fanout_replay off);
-        # a plain way/line election loss, or a fan-out budget loss with
-        # the replay leg on, retries at the next iteration.
-        stop_inv = has_inv if not fanout else jnp.zeros_like(has_inv)
-        hard_stop = active & ~serve_all \
-            & (stop_inv | (can_alloc & ~vic_dead) | (~hit & ~can_alloc)
-               | (act.owner_leg & (posr >= J_OWN)))
-        stopped = stopped | hard_stop
+        queue_on = params.dram.queue_model_enabled
+        ci = kchain.ChainIn(
+            active=active, is_ex=is_ex, is_if=is_if, line=line,
+            issue=issue, extra=extra, home=home, dset=dset, fidx=fidx,
+            hidx=hidx, drow=drow, dsharers=dsharers,
+            p_net=p_net, p_dir=p_dir, p_l2=p_l2, p_l1d=p_l1d,
+            p_l1i=p_l1i, p_core=p_core,
+            ftbl=None if queue_on else ftbl)
+        co = kchain.run_chain(params, vp, ci, H,
+                              kdispatch.chain_mode(params))
+        serve, serve_all, member = co.serve, co.serve_all, co.member
+        way, owner_leg, fan_go = co.way, co.owner_leg, co.fan_go
+        owner, evicting = co.owner, co.evicting
+        need_read, dram_wb = co.need_read, co.dram_wb
+        t_dir, inv_count = co.t_dir, co.inv_count
+        stopped = stopped | co.hard_stop
 
-        # ---- timing: identical to the round loop's zero-load path for
-        # a fast element (owner/inv/evict legs are all zero by the
-        # serve conditions)
-        net_req = noc.unicast_ps(params.net_memory, rows, home,
-                                 CTRL_BYTES, p_net, params.mesh_width,
-                                 vnet=vp.net_memory)
-        reply_ps = noc.unicast_ps(params.net_memory, home, rows,
-                                  params.line_size + CTRL_BYTES,
-                                  p_net[home], params.mesh_width,
-                                  vnet=vp.net_memory)
-        dir_ps = _lat(vp.dir_access_cycles, p_dir[home])
-        # No serialization-floor READ here: slot-axis same-line pairs are
-        # serialized by the directory-state replay itself (the later
-        # element pays the post-predecessor transition — owner flush /
-        # upgrade), which is how the oracle prices the SAME pair when it
-        # lands across two resolve passes; charging the floor ON TOP
-        # double-serialized concurrent readers the oracle combines and
-        # drifted migrate/readers probes 7-8% slow.  The pass still
-        # WRITES floors so round-loop leftovers (the genuinely
-        # concurrent class) serialize against in-pass services.
-        arrive = issue + net_req
-        t_dir = arrive + dir_ps
-        # Owner flush/downgrade round trip (zero-load unicast legs, the
-        # round loop's uncontended math; owner-side lookup in its
-        # private L2, or its L1D under shared L2).
-        p_net_own = p_net[owner]
-        if shared_l2:
-            l2_own_ps = _lat(vp.l1d_access_cycles, p_l1d[owner])
-        else:
-            l2_own_ps = _lat(vp.l2_access_cycles, p_l2[owner])
-        leg_ps = noc.unicast_ps(params.net_memory, home, owner,
-                                CTRL_BYTES, p_net[home],
-                                params.mesh_width, vnet=vp.net_memory) \
-            + l2_own_ps \
-            + noc.unicast_ps(params.net_memory, owner, home,
-                             params.line_size + CTRL_BYTES, p_net_own,
-                             params.mesh_width, vnet=vp.net_memory)
-        owner_ps = jnp.where(owner_leg, leg_ps, 0)
-        if fanout:
-            # Slot-assign the elected fan-outs ([KF, T]; budget ranks are
-            # unique among the selected rows) and expand each head's
-            # sharer bitmap to its per-sharer INV target mask.  The
-            # round trip is priced as a max-plus reduction over the
-            # sharers — the farthest unicast send + its ack, via the
-            # same noc dispatch the round loop uses (unicast-per-sharer
-            # hop math for directory-based nets, the hub broadcast leg
-            # for ATAC) — plus the directory's ack-combining cycles.
-            oh_fr = fan_go[None, :] & (
-                jnp.arange(KF, dtype=jnp.int32)[:, None]
-                == jnp.minimum(fan_rank, KF - 1)[None, :])
-
-            def fr_sel(vals):
-                return jnp.sum(jnp.where(oh_fr, vals[None, :], 0), axis=1,
-                               dtype=vals.dtype)
-
-            inv_words = jnp.sum(
-                jnp.where(oh_fr[:, :, None], act.inv_targets[None, :, :],
-                          jnp.uint64(0)), axis=1, dtype=jnp.uint64)
-            inv_bool = dirmod.bitmap_to_bool(inv_words, T)      # [KF, T]
-            home_fr = fr_sel(home)
-            pnh_fr = fr_sel(p_net[home].astype(jnp.int64)).astype(jnp.int32)
-            inv_ps_k = 2 * noc.max_hop_to_mask_ps(
-                params.net_memory, home_fr, inv_bool, CTRL_BYTES,
-                pnh_fr, params.mesh_width, vnet=vp.net_memory) \
-                + fr_sel(ack_ps)
-            inv_ps = jnp.where(fan_go, jnp.sum(
-                jnp.where(oh_fr, inv_ps_k[:, None], 0), axis=0), 0)
-            line_fr = fr_sel(line)
-            kcnt = jnp.sum(inv_bool, axis=1).astype(jnp.int64)  # [KF]
-            inv_count = jnp.where(fan_go, jnp.sum(
-                jnp.where(oh_fr, kcnt[:, None], 0), axis=0), 0)
-        else:
-            inv_count = jnp.zeros(T, dtype=jnp.int64)
-        need_read = serve_all & act.dram_read
-        if shared_l2:
-            dsite = dram_site_of_line(params, line)
-            local_ctl = home == dsite
-            to_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
-                params.net_memory, home, dsite, CTRL_BYTES, p_net[home],
-                params.mesh_width, vnet=vp.net_memory))
-            from_dram_ps = jnp.where(local_ctl, 0, noc.unicast_ps(
-                params.net_memory, dsite, home,
-                params.line_size + CTRL_BYTES, p_net[dsite],
-                params.mesh_width, vnet=vp.net_memory))
-        else:
-            dsite = home
-            to_dram_ps = from_dram_ps = jnp.int64(0)
-        dram_arrival = t_dir + owner_ps + to_dram_ps
-        dram_wb = act.dram_write & serve_all
-        if params.dram.queue_model_enabled:
+        # ---- DRAM queue + completion (the loop-carried stretch the
+        # kernel hands back; with the queue model off the kernel
+        # already produced completion/t_data and wrote the floors)
+        dsite = dram_site_of_line(params, line) if shared_l2 else home
+        if queue_on:
             # record_split: a chain iteration's batch mixes tiles at
-            # very different chain depths, i.e. very different simulated
-            # times — split busy-interval records stop one tile's
-            # far-future element from convoying another tile's whole
-            # chain (fcfs_ring's phantom-convoy note).
+            # very different simulated times — split busy-interval
+            # records stop one tile's far-future element from convoying
+            # another tile's whole chain (fcfs_ring's phantom-convoy
+            # note).
             q_start, _, _, rs_, re_, rp_, mg1_ = queue_models.probe(
                 params.dram.queue_model_type,
-                dsite, dram_arrival, jnp.full(T, dram_service_ps),
+                dsite, co.dram_arrival, jnp.full(T, dram_service_ps),
                 need_read, state.dram_ring_start, state.dram_ring_end,
                 state.dram_ring_ptr, state.dram_qacc,
-                occ_res=dsite, occ_arr=dram_arrival,
+                occ_res=dsite, occ_arr=co.dram_arrival,
                 occ_svc=jnp.full(T, dram_service_ps), occ_valid=dram_wb,
                 ma_window=params.dram.basic_ma_window,
                 record_split=2 if fanout else 1)
             state = state._replace(dram_ring_start=rs_, dram_ring_end=re_,
                                    dram_ring_ptr=rp_, dram_qacc=mg1_)
             dram_start = jnp.where(need_read, q_start, 0)
+            dram_ready = dram_start + dram_access_ps + dram_service_ps \
+                + co.from_dram_ps
+            t_data = jnp.maximum(t_dir + co.owner_ps,
+                                 jnp.where(need_read, dram_ready, 0))
+            if fanout:
+                # The data grant waits on the last invalidation ack —
+                # the round loop's exact completion rule.
+                t_data = jnp.maximum(t_data, t_dir + co.inv_ps)
+            reply_done = t_data + co.reply_ps
+            if shared_l2:
+                completion = reply_done + co.l1_fill_ps + extra
+            else:
+                completion = reply_done \
+                    + _lat(vp.l2_access_cycles, p_l2) + co.l1_fill_ps \
+                    + extra
         else:
-            dram_start = jnp.where(need_read, dram_arrival, 0)
-        dram_ready = dram_start + dram_access_ps + dram_service_ps \
-            + from_dram_ps
-        t_data = jnp.maximum(t_dir + owner_ps,
-                             jnp.where(need_read, dram_ready, 0))
-        if fanout:
-            # The data grant waits on the last invalidation ack — the
-            # round loop's exact completion rule.
-            t_data = jnp.maximum(t_data, t_dir + inv_ps)
-        reply_done = t_data + reply_ps
-        l1_fill_ps = jnp.where(
-            is_if, _lat(vp.l1i_access_cycles, p_l1i),
-            _lat(vp.l1d_access_cycles, p_l1d))
-        if shared_l2:
-            completion = reply_done + l1_fill_ps + extra
-        else:
-            completion = reply_done \
-                + _lat(vp.l2_access_cycles, p_l2) + l1_fill_ps + extra
+            t_data, completion, ftbl = co.t_data, co.completion, co.ftbl
 
         # ---- apply: directory entry + sharer-bitmap delta (winners
         # hold distinct (home, dset, way) slots by the election above)
         fidx_w = jnp.where(serve, fidx, jnp.int32(2**30))
         state = state._replace(dir_word=state.dir_word.at[
             way, fidx_w].set(
-            dword_pack(line, state.round_ctr, act.new_state,
-                       act.new_owner), mode="drop"))
+            dword_pack(line, state.round_ctr, co.new_state,
+                       co.new_owner), mode="drop"))
         # Reps land (new - old) per plane; combining members add their
-        # own bit on top of the rep's rewritten row (guarded against an
-        # already-set bit for resident S members; a cold member's bit
-        # can never be in the rep's fresh row) — ONE merged scatter-add,
-        # as in the round loop.
-        delta_sh = act.new_sharers - entry_row
+        # own bit on top of the rep's rewritten row — ONE merged
+        # scatter-add, as in the round loop.
         plane = jnp.arange(W, dtype=jnp.int32)[:, None] * A + way[None, :]
         req_word = (rows // 64).astype(jnp.int32)
         req_bit = jnp.uint64(1) << (rows % 64).astype(jnp.uint64)
-        row_f = jnp.take_along_axis(
-            dsharers, way[:, None, None], axis=1)[:, 0, :]
-        own_w = jnp.take_along_axis(row_f, req_word[:, None],
-                                    axis=1)[:, 0]
-        member_add = member & (~hit
-                               | ((own_w & req_bit) == jnp.uint64(0)))
         add_rows = jnp.concatenate(
             [plane.reshape(-1), req_word * A + way])
         add_cols = jnp.concatenate(
             [jnp.broadcast_to(fidx_w[None, :], (W, T)).reshape(-1),
-             jnp.where(member_add, fidx, jnp.int32(2**30))])
-        add_vals = jnp.concatenate([delta_sh.T.reshape(-1), req_bit])
+             jnp.where(co.member_add, fidx, jnp.int32(2**30))])
+        add_vals = jnp.concatenate([co.delta_sh.T.reshape(-1), req_bit])
         state = state._replace(dir_sharers=state.dir_sharers.at[
             add_rows, add_cols].add(add_vals, mode="drop"))
 
         # ---- owner-side downgrade deliveries: per-target [T, J_OWN]
         # line lists (ranks < J_OWN are unique per target by the budget
-        # election above), one invalidate/downgrade sweep per cache.
-        ow_put = serve & owner_leg
-        ow_tgt = jnp.where(ow_put, owner, T).astype(jnp.int32)
-        ow_slot = jnp.minimum(posr, J_OWN - 1)
+        # election), one invalidate/downgrade sweep per cache.
+        ow_tgt = jnp.where(owner_leg, owner, T).astype(jnp.int32)
+        ow_slot = co.ow_slot
         own_lines = jnp.zeros((T, J_OWN), dtype=jnp.int64).at[
             ow_tgt, ow_slot].set(line, mode="drop")
         own_valid = jnp.zeros((T, J_OWN), dtype=bool).at[
             ow_tgt, ow_slot].set(True, mode="drop")
         own_down = jnp.zeros((T, J_OWN), dtype=jnp.int32).at[
-            ow_tgt, ow_slot].set(act.owner_downgrade_to, mode="drop")
+            ow_tgt, ow_slot].set(co.down_to, mode="drop")
         if fanout:
-            # Fan-out INV deliveries ride the same per-target sweep: the
-            # [KF] served lines broadcast to every tile, masked by each
-            # slot's sharer bitmap column — one invalidate pass per
-            # cache covers owner downgrades AND sharer invalidations.
+            # Fan-out INV deliveries ride the same per-target sweep.
             dlv_lines = jnp.concatenate(
-                [own_lines, jnp.broadcast_to(line_fr[None, :], (T, KF))],
+                [own_lines,
+                 jnp.broadcast_to(co.line_fr[None, :], (T, KF))],
                 axis=1)
-            dlv_valid = jnp.concatenate([own_valid, inv_bool.T], axis=1)
+            dlv_valid = jnp.concatenate([own_valid, co.inv_bool.T],
+                                        axis=1)
             dlv_down = jnp.concatenate(
                 [own_down, jnp.full((T, KF), I, dtype=jnp.int32)], axis=1)
         else:
@@ -630,7 +394,7 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
 
         # ---- requester-side fills at serve time (the round loop's
         # winner path) + victim notify / DRAM writeback occupancy
-        granted_e = serve & ~is_ex & (act.new_state == E)
+        granted_e = serve & ~is_ex & (co.new_state == E)
         if shared_l2:
             l1_state = jnp.where(is_ex, M,
                                  jnp.where(granted_e, E, S)).astype(
@@ -715,11 +479,7 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
                     jnp.where(m_shar, rows, T), fslot].set(
                     0, mode="drop"))
             # Record coherence take-aways (the round loop's inv_dlv
-            # rule): deliveries that drop the target's copy to I —
-            # owner downgrades AND the fan-out leg's sharer
-            # invalidations — mark the TARGET tile's filter for the
-            # delivered line, so its re-miss classifies as sharing, not
-            # cold/capacity.
+            # rule) on the TARGET tiles' filters.
             inv_dlv = dlv_valid & (dlv_down == I)
             dlv_line = dlv_lines
             dslot = (dense.fmix64(dlv_line)
@@ -736,14 +496,14 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
             b(serve_all & ~is_ex), b(serve & is_ex),  # dir_sh/ex_req
             b(evicting),                          # dir_evictions
             b(owner_leg),                         # dir_writebacks
-            b(owner_leg & ~act.dram_write),       # dir_forwards
+            b(owner_leg & ~co.dram_write),        # dir_forwards
             b(serve_all) + inv_count,             # net_mem_pkts @home
             jnp.where(serve_all, flits_data, 0)
             + inv_count * flits_req,              # net_mem_flits @home
             inv_count,                            # dir_invalidations
         ]
         if shared_l2:
-            home_cols += [b(serve_all), b(serve_all & ~hit)]  # l2_access/miss
+            home_cols += [b(serve_all), b(serve_all & ~co.hit)]
             dstack = jnp.stack([b(need_read), b(dram_wb)], axis=1)
             db = jnp.zeros((T, 2), dtype=jnp.int64).at[dsite].add(dstack)
             vic_wr = 0
@@ -776,27 +536,22 @@ def chain_fast_pass(params: SimParams, vp: VariantParams, state: SimState,
             mem_stall_ps=c.mem_stall_ps + jnp.where(
                 serve_all, completion - issue, 0),
             # Round-9 occupancy: fan-outs served in-pass vs chain heads
-            # that hard-stopped into the round-loop fallback (the
-            # PROFILE.md round-9 table's two columns).
+            # that hard-stopped into the round-loop fallback.
             chain_fanout_served=c.chain_fanout_served + b(fan_go),
-            chain_fallback=c.chain_fallback + b(hard_stop),
+            chain_fallback=c.chain_fallback + b(co.hard_stop),
         )
         state = state._replace(counters=c)
 
-        # ---- serialization floor for later same-line requests (the
-        # round loop inherits this table) + chain bookkeeping.  Several
-        # rows can share one table slot this iteration (a rep with its
-        # combining members, or a hash collision between two served
-        # lines), so ONE writer per slot is elected by max availability
-        # (tile id breaking ties) — the round loop's dense path takes
-        # the same group max; an unmasked duplicate set would be
-        # backend-unspecified.
-        tkey = t_data * T + rows
-        tmax_t = jnp.full((H,), -1, jnp.int64).at[
-            jnp.where(serve_all, hidx, H)].max(tkey, mode="drop")
-        fwin = serve_all & (tmax_t[hidx] == tkey)
-        ftbl = dense.stacked_set_table(hidx, fwin,
-                                       jnp.stack([line, t_data]), ftbl)
+        # ---- serialization floor for later same-line requests (with
+        # the queue model off the kernel already wrote it)
+        if queue_on:
+            tkey = t_data * T + rows
+            tmax_t = jnp.full((H,), -1, jnp.int64).at[
+                jnp.where(serve_all, hidx, H)].max(tkey, mode="drop")
+            fwin = serve_all & (tmax_t[hidx] == tkey)
+            ftbl = dense.stacked_set_table(hidx, fwin,
+                                           jnp.stack([line, t_data]),
+                                           ftbl)
         base = jnp.where(serve_all, completion, base)
         head = head + serve_all.astype(jnp.int32)
         return state, stopped, head, base, ftbl

@@ -1,0 +1,122 @@
+"""On-disk trace cache — skip synthesis/annotation on warm runs.
+
+BENCH_r05 died at rc=124 with ``parsed: null``: the captured rows'
+trace synthesis + static-decode annotation (~890k events per capture)
+re-ran from scratch every invocation and ate the driver budget, and the
+annotator's progress lines were the last thing on stdout when the
+driver killed the process.  Generated AND annotated traces are
+deterministic functions of (generator, arguments, schema), so they
+cache as npz files keyed by a content hash:
+
+    $GRAPHITE_TRACE_CACHE   cache directory; '' disables caching
+                            (default ~/.cache/graphite_tpu/traces)
+
+``cached(key_parts, builder)`` returns the cached Trace when the key
+hits, else runs ``builder()`` and stores the result.  Corrupt or
+unreadable cache entries fall through to the builder (a cache must
+never be able to sink a run); writes go through a temp file + rename so
+a killed run can't leave a truncated entry behind.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import tempfile
+from typing import Callable, Sequence
+
+# Bump to invalidate every cached trace (event-schema or generator
+# semantics changes).
+CACHE_VERSION = 1
+
+
+def cache_dir() -> str:
+    """Resolved cache directory ('' = caching disabled)."""
+    d = os.environ.get("GRAPHITE_TRACE_CACHE")
+    if d is None:
+        d = os.path.join(os.path.expanduser("~"), ".cache",
+                         "graphite_tpu", "traces")
+    return d
+
+
+def file_digest(paths: Sequence) -> str:
+    """sha256 over the CONTENT of ``paths`` (in order) — cache keys must
+    change when the code that generates the trace changes, not only when
+    its arguments do (an edited generator silently served the pre-edit
+    trace otherwise).  Missing/unreadable files hash as their name, so a
+    key can still form (the builder will fail loudly on its own)."""
+    h = hashlib.sha256()
+    for p in paths:
+        h.update(b"\x00")
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(str(p).encode())
+    return h.hexdigest()
+
+
+def cache_key(key_parts: Sequence, src_files: Sequence = ()) -> str:
+    """Stable content hash of the generator identity + arguments + the
+    generating code's file contents."""
+    h = hashlib.sha256()
+    h.update(f"v{CACHE_VERSION}".encode())
+    for part in key_parts:
+        h.update(b"\x00")
+        h.update(repr(part).encode())
+    if src_files:
+        h.update(file_digest(src_files).encode())
+    return h.hexdigest()[:32]
+
+
+def _schema_file() -> str:
+    from graphite_tpu.events import schema
+    return schema.__file__
+
+
+def cached(key_parts: Sequence, builder: Callable[[], "Trace"],
+           src_files: Sequence = ()):
+    """Return the Trace for ``key_parts``, from cache when possible.
+
+    ``src_files``: files whose CONTENT the built trace depends on (the
+    generator module, vendored benchmark sources, the capture
+    toolchain); the event schema module is always included."""
+    from graphite_tpu.events.schema import Trace
+
+    d = cache_dir()
+    if not d:
+        return builder()
+    path = os.path.join(
+        d, cache_key(key_parts,
+                     list(src_files) + [_schema_file()]) + ".npz")
+    if os.path.exists(path):
+        try:
+            return Trace.load(path)
+        except Exception as e:   # corrupt entry: rebuild, best-effort drop
+            print(f"trace_cache: unreadable entry {path}: {e}",
+                  file=sys.stderr)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    trace = builder()
+    tmp = None
+    try:
+        os.makedirs(d, exist_ok=True)
+        # Suffix must stay ".npz" — np.savez appends it otherwise.
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+        os.close(fd)
+        trace.save(tmp)
+        os.replace(tmp, path)
+        tmp = None
+    except Exception as e:       # full disk, read-only home, ...
+        print(f"trace_cache: write failed for {path}: {e}",
+              file=sys.stderr)
+    finally:
+        if tmp is not None:      # failed save must not orphan its tmp
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return trace

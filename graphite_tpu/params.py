@@ -86,6 +86,17 @@ def _miss_chain(value: int) -> int:
     return value
 
 
+_PALLAS_KERNEL_MODES = ("auto", "off", "interpret", "on")
+
+
+def _pallas_kernels(value: str) -> str:
+    if value not in _PALLAS_KERNEL_MODES:
+        raise ConfigError(
+            f"tpu/pallas_kernels must be one of {_PALLAS_KERNEL_MODES}: "
+            f"{value!r}")
+    return value
+
+
 def _syscall_costs(cfg: Config) -> tuple:
     """[syscall] per-class service cycles, ordered by isa.SyscallClass."""
     from graphite_tpu.isa import SyscallClass
@@ -726,6 +737,20 @@ class SimParams:
     # and advance the barrier past served chain progress.  False
     # restores the round-8 chain engine — the bench fft64 A/B switch.
     fanout_replay: bool
+    # Round-10 Pallas round-cost kernels (engine/kernels/): run the block
+    # window's K-deep walk and the chain replay's classify/elect/combine
+    # phase as fused TPU kernels over VMEM-resident operands instead of
+    # dozens of sequentially dispatched XLA ops.  A STRING so the sweep
+    # zoo classifies it structural by nature:
+    #   "auto"      — real Pallas on a TPU backend, plain lax elsewhere
+    #   "off"       — always the lax reference path
+    #   "interpret" — Pallas kernels under the interpreter (CPU-testable;
+    #                 the bit-identity gate in tests/test_kernels.py)
+    #   "on"        — force real Pallas lowering (TPU only)
+    # Results are bit-identical across all values — the kernels run the
+    # SAME walk/classify code on block-sliced operands (all-integer
+    # arithmetic; per-tile independent), dispatched in kernels/dispatch.
+    pallas_kernels: str
     channel_depth: int
     # Captured-trace replay: a recorded COND_WAIT provably consumed SOME
     # signal in the native run, but simulated retiming can invert the
@@ -995,6 +1020,8 @@ class SimParams:
                 cfg.get_int("tpu/max_resolve_rounds", 4),
                 "tpu/max_resolve_rounds"),
             fanout_replay=cfg.get_bool("tpu/fanout_replay", True),
+            pallas_kernels=_pallas_kernels(
+                cfg.get_str("tpu/pallas_kernels", "auto")),
             channel_depth=cfg.get_int("tpu/channel_depth", 16),
             cond_replay=cfg.get_bool("tpu/cond_replay", False),
         )

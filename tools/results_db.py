@@ -27,9 +27,13 @@ hide a fixed-cost one; variants/s is the sweep engine's own unit
 (config points per host second) and is invisible to both; events/round
 is the round-COUNT levers' metric (chain replay, fan-out leg) — a
 cadence regression is invisible to all three others on a CPU host,
-where per-round dispatch cost is ~free.  Each metric chains to the
-most recent prior row that HAS it, so probe/skipped rows can't mask a
-later regression.
+where per-round dispatch cost is ~free.  Structural op counts
+(``lowered_window_calls``, ``lowered_resolve_scatters_on`` — round 10's
+Pallas-kernel fusion evidence) flag on ANY increase: the window phase
+fragmenting out of its single custom-call is a 1 -> N event, invisible
+to every throughput metric on CPU.  Each metric chains to the most
+recent prior row that HAS it, so probe/skipped rows can't mask a later
+regression.
 
 Sweep rows ingest like bench rows: a ``graphite-tpu sweep -o`` output
 or a bench ``radix8_sweep8`` detail row carries ``variants`` +
@@ -133,6 +137,31 @@ def variants_per_sec(row: dict):
     return float(n) / float(host_s)
 
 
+def _count_metric(key):
+    """Lower-is-better structural count (e.g. ``lowered_window_calls``:
+    pallas_call sites in the lowered window round — 1 when the phase is
+    fused, 0 in a row recorded with kernels off).  None when absent."""
+    def fn(row: dict):
+        v = row.get(key)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return None
+        return v if v >= 0 else None
+    return fn
+
+
+# Structural op-count metrics (round 10): an INCREASE is the regression
+# — the window phase fragmenting out of its single custom-call, or the
+# resolve pass regrowing sequential scatters.  Exact small integers, so
+# any increase flags (no percentage band).
+COUNT_METRICS = (
+    ("lowered_window_calls", _count_metric("lowered_window_calls")),
+    ("lowered_resolve_scatters_on",
+     _count_metric("lowered_resolve_scatters_on")),
+)
+
+
 def check_regression(db: sqlite3.Connection, workload: str, row: dict,
                      threshold_pct: float = REGRESSION_PCT):
     """Compare ``row``'s rounds/s AND simulated MIPS against the most
@@ -167,6 +196,27 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
                 f"REGRESSION {workload}: {new:.1f} {name} vs prior "
                 f"{old:.1f} (-{drop:.0f}% > {threshold_pct:.0f}% "
                 f"threshold)")
+    # Structural counts: lower is better, exact — ANY increase over the
+    # most recent prior row carrying the metric flags (the window phase
+    # fragmenting out of its one custom-call is a 1 -> N event, not a
+    # percentage drift).
+    for name, fn in COUNT_METRICS:
+        new = fn(row)
+        if new is None:
+            continue
+        old = None
+        for (raw,) in db.execute(
+                "SELECT raw_json FROM runs WHERE workload = ? "
+                "ORDER BY ts DESC, id DESC", (workload,)):
+            old = fn(json.loads(raw))
+            if old is not None:
+                break
+        if old is None:
+            continue
+        if new > old:
+            warnings.append(
+                f"REGRESSION {workload}: {name} rose {old:.0f} -> "
+                f"{new:.0f} (structural op count must not grow)")
     return "\n".join(warnings) if warnings else None
 
 

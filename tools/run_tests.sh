@@ -209,6 +209,73 @@ if [ $sweep_rc -ne 0 ]; then
     fail=1
 fi
 
+# Pallas-kernel smoke gate (ISSUE 10 CI satellite): (1) the dispatch
+# layer must select the lax path on CPU under the default "auto" (the
+# kernels buy nothing without per-op dispatch cost and Mosaic cannot
+# lower there); (2) a tiny-shape interpret run must be BIT-IDENTICAL to
+# the lax run — clocks, every counter, every phase-execution counter —
+# through the whole engine including the chain replay's classify
+# kernel; (3) the window phase with kernels on must lower to exactly
+# ONE pallas_call equation (the single-custom-call contract results_db
+# tracks as lowered_window_calls).
+pallas_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import dataclasses
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import numpy as np
+from graphite_tpu.config import load_config
+from graphite_tpu.engine import core
+from graphite_tpu.engine.kernels import dispatch as kdispatch
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.engine.vparams import variant_params
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+trace = synth.gen_radix(2, keys_per_tile=24, radix=8, seed=3)
+
+def run(mode):
+    cfg = load_config()
+    cfg.set("general/total_cores", 2)
+    cfg.set("tpu/miss_chain", 4)
+    cfg.set("tpu/pallas_kernels", mode)
+    params = SimParams.from_config(cfg)
+    sim = Simulator(params, trace)
+    s = sim.run(max_steps=64)
+    return params, sim, s
+
+p_auto = SimParams.from_config(load_config())
+assert p_auto.pallas_kernels == "auto"
+if jax.default_backend() != "tpu":
+    assert kdispatch.kernels_mode(p_auto) == "off", \
+        "auto must resolve to lax off-TPU"
+
+pa, sa, a = run("off")
+pb, sb, b = run("interpret")
+assert a.done.all() and b.done.all()
+assert np.array_equal(a.clock, b.clock), "clocks diverge"
+for k in a.counters:
+    assert np.array_equal(a.counters[k], b.counters[k]), k
+for f in ("ctr_quantum", "ctr_window", "ctr_complex", "ctr_conflict",
+          "ctr_resolve", "round_ctr"):
+    va, vb = int(getattr(sa.state, f)), int(getattr(sb.state, f))
+    assert va == vb, f"{f}: {va} != {vb}"
+
+vp = variant_params(pb)
+c = kdispatch.jaxpr_op_counts(
+    lambda s: core._block_retire(pb, vp, s, sb.trace), sb.state)
+assert c["pallas_call"] == 1, f"window phase must be ONE call: {c}"
+print(f"PALLAS SMOKE OK (interpret bit-identical, "
+      f"{int(sa.state.round_ctr)} rounds, window pallas_call=1)")
+PYEOF
+)
+pallas_rc=$?
+echo "$pallas_out" | tail -3
+if [ $pallas_rc -ne 0 ]; then
+    echo "PALLAS SMOKE GATE FAILED"
+    fail=1
+fi
+
 # Chain-oracle gate (ISSUE 6): the blocking-semantics miss-chain engine
 # must match the one-parked-request oracle within 2% — these equality
 # tests were xfail documentation of the round-4 MSHR machine's
