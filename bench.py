@@ -525,6 +525,8 @@ def _captured_row(name: str):
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    from graphite_tpu.compile_cache import enable_compile_cache
+    enable_compile_cache()
     if "--help" in argv or "-h" in argv:
         print(__doc__)
         print(f"env: GRAPHITE_BENCH_BUDGET_S   wall-clock budget in "
@@ -666,6 +668,22 @@ def main(argv=None) -> int:
         lambda T: _synth_cached("gen_radix", synth.gen_radix,
                                 num_tiles=T, keys_per_tile=16, radix=64),
         1024, label="radix1024", **{"tpu/block_events": 4}))
+
+    def shard8_ab():
+        """Round-11 scale-out A/B: the radix1024 shape with
+        ``tpu/tile_shards = 8`` vs 1, in a fresh 8-device subprocess
+        (this process does not force virtual devices) — reports
+        quanta_per_s for both legs and the bit-identity flag.  On CPU
+        the sharded leg prices loopback-collective rendezvous, so the
+        ratio bounds coordination overhead from above; the same row on
+        a TPU slice is the real scale-out number (PROFILE.md r11)."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        from weak_scaling import bench_shard8_row
+        remaining = max(budget_s - (time.monotonic() - t_start), 60.0)
+        return bench_shard8_row(tiles=1024, timeout=remaining)
+
+    safe("radix1024_shard8", shard8_ab)
     # BASELINE config 2: directory-MSI coherence stress at 256 tiles,
     # sized to complete.
     safe("fft256", lambda: _run(

@@ -1,0 +1,56 @@
+"""Persistent XLA compile-cache policy for the ENTRY POINTS (cli.py,
+bench.py, the weak-scaling legs).
+
+The package import (graphite_tpu/__init__.py) already points jax at
+``<repo>/.jax_cache`` when running from a checkout — the right default
+for tests and development, where the cache should live and die with the
+tree.  The launchers add a user-level policy on top, because a CLI
+invocation may run from an INSTALLED package (no checkout, so no cache
+at all) and megarun programs cost minutes of XLA compile time per
+(params, shapes) key:
+
+  * ``$GRAPHITE_COMPILE_CACHE`` set to a path — use exactly that.
+  * set but EMPTY — disable persistent caching for this process.
+  * unset — keep whatever the import chose (checkout cache); if the
+    import chose nothing, fall back to ``~/.cache/graphite_tpu/xla``.
+
+Call :func:`enable_compile_cache` before the first jit dispatch; it is
+idempotent and never raises for an unwritable directory (jax degrades
+to in-memory caching on cache I/O errors).
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_CACHE = os.path.join("~", ".cache", "graphite_tpu", "xla")
+ENV_VAR = "GRAPHITE_COMPILE_CACHE"
+
+
+def resolve_cache_dir(env: dict | None = None) -> str | None:
+    """The directory the policy selects, or None to disable.  Split from
+    the jax.config mutation so tests can check the policy pure."""
+    env = os.environ if env is None else env
+    raw = env.get(ENV_VAR)
+    if raw is not None:
+        return os.path.expanduser(raw) if raw.strip() else None
+    import jax
+    current = jax.config.jax_compilation_cache_dir
+    if current:
+        return current
+    return os.path.expanduser(DEFAULT_CACHE)
+
+
+def enable_compile_cache() -> str | None:
+    """Apply the policy; returns the active cache dir (None = disabled)."""
+    import jax
+
+    target = resolve_cache_dir()
+    if target is None:
+        jax.config.update("jax_compilation_cache_dir", None)
+        return None
+    os.makedirs(target, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", target)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return target

@@ -106,12 +106,15 @@ def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:
                     f"checkpoint field {key!r} shape {a.shape} != expected "
                     f"{tmpl.shape} (params mismatch?)")
             # Commit each leaf to a device array NOW, from an OWNED host
-            # copy: the engine's megarun/megastep donate their state
-            # argument, and donating a leaf that is still a host numpy
-            # view of the (mmap'd) npz is an aliasing hazard on the CPU
-            # backend (observed as nondeterministic wrong results /
-            # bitcast garbage in resumed runs).  jnp.array(copy=True) —
-            # not asarray, which zero-copies aligned host buffers.
+            # copy: under GRAPHITE_DONATE_STATE=1 megarun/megastep
+            # donate their state argument, and donating a leaf that is
+            # still a host numpy view of the (mmap'd) npz is an aliasing
+            # hazard on the CPU backend (observed as nondeterministic
+            # wrong results / bitcast garbage in resumed runs — the same
+            # buffer-lifetime bug class that made donation opt-in,
+            # engine/quantum.py state_donation_enabled).
+            # jnp.array(copy=True) — not asarray, which zero-copies
+            # aligned host buffers.
             leaves.append(jnp.array(a, dtype=tmpl.dtype, copy=True))
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     return state, steps

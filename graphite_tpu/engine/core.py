@@ -215,8 +215,15 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
         lq_ready=st.lq_ready if iocoom else None,
         sq_ready=st.sq_ready if iocoom else None,
     )
-    out = kwindow.run_window(params, vp, wi, S_ids,
-                             kdispatch.window_mode(params))
+    # Sharded dispatch (tpu/tile_shards > 1, inside the quantum
+    # program's shard_map): each device walks its own T/S tile slice and
+    # all_gathers the results — the whole walk is shard-local compute.
+    if params.tile_shards > 1:
+        out = kwindow.run_window_sharded(params, vp, wi, S_ids,
+                                         kdispatch.window_mode(params))
+    else:
+        out = kwindow.run_window(params, vp, wi, S_ids,
+                                 kdispatch.window_mode(params))
 
     # ---- SPAWN: start the child's stream once the request lands on its
     # tile — the walk's one cross-tile effect, applied here as a single

@@ -240,7 +240,14 @@ def jaxpr_op_counts(fn, *args) -> dict:
     custom-call by construction."""
     closed = jax.make_jaxpr(fn)(*args)
     counts = {"eqns": 0, "gather": 0, "scatter": 0, "pallas_call": 0,
-              "while": 0, "fori_or_scan": 0}
+              "while": 0, "fori_or_scan": 0, "collective": 0}
+
+    # Cross-device communication primitives (startswith, to catch the
+    # psum/psum2 and reduce_scatter naming variants across jax versions).
+    # The round-11 sharding gates assert the shard-LOCAL window phase has
+    # zero of these and the whole sharded step a small bounded count.
+    _COLLECTIVES = ("all_gather", "psum", "pmin", "pmax", "all_to_all",
+                    "ppermute", "reduce_scatter", "pbroadcast")
 
     def visit(jaxpr):
         for eqn in jaxpr.eqns:
@@ -256,6 +263,8 @@ def jaxpr_op_counts(fn, *args) -> dict:
                 counts["while"] += 1
             elif prim == "scan":
                 counts["fori_or_scan"] += 1
+            if prim.startswith(_COLLECTIVES):
+                counts["collective"] += 1
             # Recurse into sub-jaxprs (loop/cond/pjit bodies ride in
             # eqn params) — pallas_call kernel jaxprs are deliberately
             # NOT descended into: their ops are fused inside one call.

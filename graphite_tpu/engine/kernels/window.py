@@ -647,3 +647,64 @@ def run_window(params: SimParams, vp: VariantParams, wi: WindowIn,
         lambda wi2, vp2: window_walk(params, vp2, wi2, s_ids),
         wi, vp, WINDOW_IN_AXES, WindowOut, WINDOW_OUT_AXES,
         params.num_tiles, mode, "window_walk")
+
+
+def shard_local_window_in(wi: WindowIn, shard_idx, tiles_local: int
+                          ) -> WindowIn:
+    """Slice every walk operand to one shard's ``tiles_local`` tiles
+    along its declared tile axis (``WINDOW_IN_AXES``; None-axis leaves —
+    the quantum boundary, the model-enable mask — replicate).
+
+    ``shard_idx`` is ``lax.axis_index`` inside the live shard_map; the
+    structural gates (tests/test_sharding.py, tools/run_tests.sh) pass a
+    CONCRETE 0 instead, which yields the exact per-shard shapes without
+    needing a mesh — the CPU-checkable form of the shard-local claim."""
+
+    def slc(name, leaf):
+        ax = WINDOW_IN_AXES[name]
+        if leaf is None or ax is None:
+            return leaf
+        return jax.lax.dynamic_slice_in_dim(
+            leaf, shard_idx * tiles_local, tiles_local, axis=ax)
+
+    return WindowIn(**{f: slc(f, v) for f, v in zip(WindowIn._fields, wi)})
+
+
+def run_window_sharded(params: SimParams, vp: VariantParams, wi: WindowIn,
+                       s_ids: int, mode: str) -> WindowOut:
+    """The walk under ``tpu/tile_shards`` > 1 (inside the quantum
+    program's shard_map, parallel/mesh.shard_wrap): slice every operand
+    to this shard's T/S tiles along its declared tile axis, run the
+    UNCHANGED walk on the slice, and tiled-all_gather each output back
+    to the full [T] arrays the apply shell expects.
+
+    Bit-identity is by construction: ``window_walk`` is per-tile
+    independent and shape-polymorphic over the tile axis (TL =
+    wi.clock.shape[0]; ``wi.tile_ids`` carries GLOBAL ids, so sliced
+    spawn targets stay correct), and a tiled all_gather over the mesh
+    axis concatenates the shard blocks back in exact tile order.  The
+    walk itself — the PROFILE.md round-5 cost center — executes with
+    ZERO cross-device traffic; the only collectives this path adds are
+    the output all_gathers (one per live WindowOut leaf, counted by the
+    structural gate in tools/run_tests.sh)."""
+    from graphite_tpu.parallel.mesh import TILE_AXIS
+
+    shards = params.tile_shards
+    TL = params.num_tiles // shards
+    wi_l = shard_local_window_in(wi, jax.lax.axis_index(TILE_AXIS), TL)
+    if mode == "off":
+        out_l = window_walk(params, vp, wi_l, s_ids)
+    else:
+        out_l = dispatch.run_fused(
+            lambda wi2, vp2: window_walk(params, vp2, wi2, s_ids),
+            wi_l, vp, WINDOW_IN_AXES, WindowOut, WINDOW_OUT_AXES,
+            TL, mode, "window_walk")
+
+    def gather(name, leaf):
+        if leaf is None:
+            return None
+        return jax.lax.all_gather(leaf, TILE_AXIS,
+                                  axis=WINDOW_OUT_AXES[name], tiled=True)
+
+    return WindowOut(**{f: gather(f, v)
+                        for f, v in zip(WindowOut._fields, out_l)})

@@ -68,7 +68,20 @@ def next_boundary(params: SimParams, state: SimState,
         # (round 9; off with fanout_replay=0 — the round-8 cadence).
         clk = jnp.where(state.mq_head > 0,
                         jnp.maximum(clk, state.chain_base), clk)
-    min_clock = jnp.min(jnp.where(runnable, clk, TIME_MAX))
+    masked = jnp.where(runnable, clk, TIME_MAX)
+    if params.tile_shards > 1:
+        # Sharded quantum barrier (round 11): each shard reduces its own
+        # T/S tile slice, then a pmin over the mesh axis produces the
+        # global minimum — the explicit-collective form of the barrier
+        # server, exactly equal to the full-T min (integer clocks, and
+        # the shard slices partition the tile axis).
+        from graphite_tpu.parallel.mesh import TILE_AXIS
+        TL = masked.shape[0] // params.tile_shards
+        i = jax.lax.axis_index(TILE_AXIS)
+        local = jnp.min(jax.lax.dynamic_slice_in_dim(masked, i * TL, TL, 0))
+        min_clock = jax.lax.pmin(local, TILE_AXIS)
+    else:
+        min_clock = jnp.min(masked)
     q = vp.quantum_ps if vp is not None else jnp.int64(params.quantum_ps)
     nb = (min_clock // q + 1) * q
     return jnp.where(runnable.any(), nb,
@@ -361,18 +374,57 @@ def quantum_step(params: SimParams, state: SimState,
     return state
 
 
-@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def _megastep_impl(params: SimParams, state: SimState,
+                   trace: TraceArrays) -> SimState:
+    from graphite_tpu.parallel.mesh import shard_wrap
+    vp = variant_params(params)
+
+    def run(state, trace):
+        def body(st, _):
+            return quantum_step(params, st, trace, vp=vp), None
+
+        st, _ = jax.lax.scan(body, state, None,
+                             length=params.quanta_per_step)
+        return st
+
+    return shard_wrap(params.tile_shards, run, 2)(state, trace)
+
+
+# State donation is OFF by default.  Chained donation (each window's
+# output donated as the next window's input) races buffer lifetime on
+# the CPU PJRT client: a long-lived final state can end up referencing
+# storage the allocator hands to a LATER compiled program, which then
+# scribbles over it — observed as garbage in pass-through leaves
+# (period_ps) once more simulations ran in the same process.  The
+# corruption reproduces on the pre-round-11 tree with sharding never
+# touched, so it is the donation chain itself, not shard_map; it is
+# also racy (allocation-order dependent), which is how it survived ten
+# rounds of green tests.  GRAPHITE_DONATE_STATE=1 opts back into
+# donation (halves peak state memory on HBM-bound runs) for runtimes
+# where the chain is known safe; the sharded path never donates.
+def state_donation_enabled() -> bool:
+    import os
+    return os.environ.get("GRAPHITE_DONATE_STATE", "") == "1"
+
+
+_megastep_donate = partial(jax.jit, static_argnums=0,
+                           donate_argnums=1)(_megastep_impl)
+_megastep_nodonate = partial(jax.jit, static_argnums=0)(_megastep_impl)
+
+
 def megastep(params: SimParams, state: SimState,
              trace: TraceArrays) -> SimState:
     """``quanta_per_step`` quantum steps fused into one device program —
-    the unit the host driver launches (and the unit `bench.py` times)."""
-    vp = variant_params(params)
+    the unit the host driver launches (and the unit `bench.py` times).
 
-    def body(st, _):
-        return quantum_step(params, st, trace, vp=vp), None
-
-    state, _ = jax.lax.scan(body, state, None, length=params.quanta_per_step)
-    return state
+    With ``tpu/tile_shards`` > 1 the whole step body runs under
+    shard_map (parallel/mesh.shard_wrap): state and trace stay
+    replicated, the window walk slices to per-shard tiles inside
+    (kernels/window.run_window_sharded), and the quantum barrier is a
+    pmin.  At 1 the wrapper is the identity — today's program."""
+    if params.tile_shards <= 1 and state_donation_enabled():
+        return _megastep_donate(params, state, trace)
+    return _megastep_nodonate(params, state, trace)
 
 
 def megarun_loop(params: SimParams, vp: VariantParams, state: SimState,
@@ -419,7 +471,22 @@ def megarun_loop(params: SimParams, vp: VariantParams, state: SimState,
     return state
 
 
-@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def _megarun_impl(params: SimParams, state: SimState, trace: TraceArrays,
+                  max_quanta) -> SimState:
+    from graphite_tpu.parallel.mesh import shard_wrap
+
+    def run(state, trace, vp, mq):
+        return megarun_loop(params, vp, state, trace, mq, masked=False)
+
+    return shard_wrap(params.tile_shards, run, 4)(
+        state, trace, variant_params(params), max_quanta)
+
+
+_megarun_donate = partial(jax.jit, static_argnums=0,
+                          donate_argnums=1)(_megarun_impl)
+_megarun_nodonate = partial(jax.jit, static_argnums=0)(_megarun_impl)
+
+
 def megarun(params: SimParams, state: SimState, trace: TraceArrays,
             max_quanta) -> SimState:
     """Run quantum steps ON DEVICE until the simulation completes or
@@ -434,6 +501,14 @@ def megarun(params: SimParams, state: SimState, trace: TraceArrays,
     the dispatch boundary.  ``max_quanta`` is a TRACED scalar so every
     window size shares one compiled program (the warm-up run must warm
     the real program).
+
+    Sharding rides the same wrapper as ``megastep``: with
+    ``tpu/tile_shards`` > 1 the loop body (window slicing, the pmin
+    barrier, the replicated resolve) runs under shard_map; at 1 the
+    wrapper is the identity and this is today's program, bit for bit.
+    State donation is opt-in and 1-only (see the note above
+    ``state_donation_enabled``).
     """
-    return megarun_loop(params, variant_params(params), state, trace,
-                        max_quanta, masked=False)
+    if params.tile_shards <= 1 and state_donation_enabled():
+        return _megarun_donate(params, state, trace, max_quanta)
+    return _megarun_nodonate(params, state, trace, max_quanta)

@@ -13,15 +13,35 @@ collectives riding ICI, and the quantum min-reduction is the barrier.
 Multi-host scaling rides the same mechanism: `jax.distributed` extends the
 mesh across hosts (ICI within a slice, DCN across), with no engine changes
 — the reference needed ssh spawners and a socket fabric for the same reach
-(tools/spawn_master.py).  Proven end to end by tools/multihost_dryrun.py
-(tests/test_multihost.py): two coordinator-connected processes run one
+(tools/spawn_master.py).  tools/multihost_dryrun.py (tests/test_multihost.py)
+exercises the two-process path: coordinator-connected processes run one
 fused megastep over a global 8-device mesh with collectives crossing the
-process boundary.
+process boundary (capability-probed first — the CPU backend refuses
+cross-process computations).
+
+Two sharding mechanisms live here, one current and one superseded:
+
+  * **Explicit shard_map** (``tpu/tile_shards`` > 1, round 11 — the
+    CURRENT path): :func:`shard_wrap` wraps the quantum program in
+    ``shard_map`` over this mesh with every operand replicated; inside,
+    the engine slices ONLY the block window's operands to the shard's
+    T/S tiles (engine/kernels/window.run_window_sharded), all_gathers
+    the walk's outputs back, and reduces the quantum barrier with an
+    explicit ``pmin`` — the ZSim bound-weave shape: a shard-local bound
+    phase with ZERO cross-device traffic, then a bounded set of
+    explicit collectives.
+  * **GSPMD auto-sharding** (:func:`shard_pytree` under a whole-program
+    jit — SUPERSEDED as the scale-out path): device_put the state
+    tile-sharded and let the partitioner guess.  Measured 0.95x on 8
+    CPU devices (pure overhead: resolve's full-T gathers/scatters force
+    resharding of everything — PROFILE.md round 11).  It remains the
+    placement layer for multi-host dryruns and the resharding-on-restore
+    tests, not the performance path.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import numpy as np
@@ -34,6 +54,34 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
               axis: str = TILE_AXIS) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     return Mesh(np.array(devices), (axis,))
+
+
+def shard_wrap(tile_shards: int, fn: Callable, nargs: int) -> Callable:
+    """Wrap ``fn(*nargs arrays/pytrees)`` in ``shard_map`` over the first
+    ``tile_shards`` devices when sharding is on; the identity at 1 — the
+    single-device program is untouched, bit for bit.
+
+    Every in/out spec is REPLICATED (``P()``): the engine's state stays
+    whole on every device, and the sharded work happens INSIDE ``fn``
+    via ``lax.axis_index`` slicing (the window walk) + explicit
+    collectives (all_gather, the pmin barrier).  Replication also makes
+    the bit-identity contract structural — each shard computes the same
+    full-T arrays wherever it is not explicitly sliced.
+    ``check_rep=False`` because the engine's while_loops and explicit
+    collectives defeat the replication checker, not because anything is
+    unreplicated."""
+    if tile_shards <= 1:
+        return fn
+    from jax.experimental.shard_map import shard_map
+    devices = jax.devices()
+    if len(devices) < tile_shards:
+        raise ValueError(
+            f"tpu/tile_shards={tile_shards} needs at least that many "
+            f"devices; jax sees {len(devices)} (force virtual CPU "
+            f"devices with --xla_force_host_platform_device_count)")
+    mesh = make_mesh(devices[:tile_shards])
+    return shard_map(fn, mesh=mesh, in_specs=(P(),) * nargs,
+                     out_specs=P(), check_rep=False)
 
 
 # Tile-axis position per engine array field.  Engine arrays keep small

@@ -97,6 +97,38 @@ def _pallas_kernels(value: str) -> str:
     return value
 
 
+def _tile_shards(raw: str, num_tiles: int) -> int:
+    """Resolve ``tpu/tile_shards`` to a concrete shard count.
+
+    ``"auto"`` takes the largest divisor of the tile count that the
+    attached device set can carry (1 on a single device — today's
+    program); an explicit integer must divide ``num_tiles`` evenly and
+    fit the device count, because shard_map splits the tile axis into
+    equal per-device blocks.  The resolved value is STATIC: it selects
+    the compiled program (sharded vs single-device), so it lives in
+    SimParams like ``pallas_kernels`` rather than in a runtime flag.
+    """
+    if raw == "auto":
+        import jax
+        d = jax.local_device_count()
+        s = max(v for v in range(1, d + 1) if num_tiles % v == 0)
+        return s
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ConfigError(
+            f"tpu/tile_shards must be 'auto' or a positive integer: "
+            f"{raw!r}")
+    if value < 1:
+        raise ConfigError(f"tpu/tile_shards must be >= 1: {value}")
+    if num_tiles % value:
+        raise ConfigError(
+            f"tpu/tile_shards={value} must divide the tile count "
+            f"{num_tiles} (shard_map splits the tile axis into equal "
+            f"per-device blocks)")
+    return value
+
+
 def _syscall_costs(cfg: Config) -> tuple:
     """[syscall] per-class service cycles, ordered by isa.SyscallClass."""
     from graphite_tpu.isa import SyscallClass
@@ -751,6 +783,17 @@ class SimParams:
     # SAME walk/classify code on block-sliced operands (all-integer
     # arithmetic; per-tile independent), dispatched in kernels/dispatch.
     pallas_kernels: str
+    # Round-11 explicit tile-axis sharding (parallel/mesh.py): the
+    # RESOLVED shard count of the quantum step's shard_map over the
+    # device mesh.  1 is today's single-device program, bit for bit
+    # (no shard_map wrapper is applied at all); S > 1 runs the block
+    # window's walk on T/S tiles per device (sliced operands, outputs
+    # all_gathered back) with the quantum barrier as an explicit pmin
+    # collective, everything else replicated.  Bit-identical across
+    # values — the gate in tests/test_sharding.py.  Config accepts
+    # "auto" (largest divisor of T the device set carries) or an
+    # explicit divisor of T; the field always holds the resolved int.
+    tile_shards: int
     channel_depth: int
     # Captured-trace replay: a recorded COND_WAIT provably consumed SOME
     # signal in the native run, but simulated retiming can invert the
@@ -1022,6 +1065,8 @@ class SimParams:
             fanout_replay=cfg.get_bool("tpu/fanout_replay", True),
             pallas_kernels=_pallas_kernels(
                 cfg.get_str("tpu/pallas_kernels", "auto")),
+            tile_shards=_tile_shards(
+                cfg.get_str("tpu/tile_shards", "1"), T),
             channel_depth=cfg.get_int("tpu/channel_depth", 16),
             cond_replay=cfg.get_bool("tpu/cond_replay", False),
         )

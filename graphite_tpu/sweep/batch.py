@@ -61,19 +61,45 @@ def _count_trace():
     _COMPILE_COUNT += 1
 
 
-@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def _sweep_megarun_impl(canon: SimParams, bstate, bvp,
+                        trace: TraceArrays, max_quanta):
+    from graphite_tpu.parallel.mesh import shard_wrap
+    _count_trace()
+
+    def run(bstate, bvp, trace, max_quanta):
+        def one(st, vp):
+            return megarun_loop(canon, vp, st, trace, max_quanta)
+
+        return jax.vmap(one, in_axes=(0, 0))(bstate, bvp)
+
+    return shard_wrap(canon.tile_shards, run, 4)(
+        bstate, bvp, trace, max_quanta)
+
+
+# State donation is opt-in (GRAPHITE_DONATE_STATE=1) and only without
+# sharding — the donation chain races buffer lifetime on the CPU PJRT
+# client (engine/quantum.py state_donation_enabled has the full note).
+_sweep_donate = partial(jax.jit, static_argnums=0,
+                        donate_argnums=1)(_sweep_megarun_impl)
+_sweep_nodonate = partial(jax.jit, static_argnums=0)(_sweep_megarun_impl)
+
+
 def sweep_megarun(canon: SimParams, bstate, bvp, trace: TraceArrays,
                   max_quanta):
     """One device dispatch advancing every variant up to ``max_quanta``
     quanta (or its own completion).  ``canon`` must be the CANONICAL
     params of the bucket (space.canonical_params) so the jit cache keys
-    on structure, not on visited design points."""
-    _count_trace()
+    on structure, not on visited design points.
 
-    def one(st, vp):
-        return megarun_loop(canon, vp, st, trace, max_quanta)
-
-    return jax.vmap(one, in_axes=(0, 0))(bstate, bvp)
+    With ``tpu/tile_shards`` > 1 the two batch axes compose: shard_map
+    OUTSIDE, vmap INSIDE (parallel/mesh.shard_wrap wraps the vmapped
+    body).  The engine's slicing code is written against unbatched tile
+    axes, so vmap lifts it over the [V] lane axis while the mesh axis
+    splits tiles — V variants x T/S tiles per device in ONE program."""
+    from graphite_tpu.engine.quantum import state_donation_enabled
+    if canon.tile_shards <= 1 and state_donation_enabled():
+        return _sweep_donate(canon, bstate, bvp, trace, max_quanta)
+    return _sweep_nodonate(canon, bstate, bvp, trace, max_quanta)
 
 
 def _stack(trees):

@@ -101,6 +101,7 @@ STRUCTURAL_LEAVES = frozenset({
     "block_events", "max_events_per_quantum", "directory_conflict_rounds",
     "rounds_per_quantum", "quanta_per_step", "max_inv_fanout_per_round",
     "miss_chain", "max_resolve_rounds", "channel_depth",
+    "tile_shards",                # selects the sharded vs solo program
 } | {f"{c}.{f}" for c in ("l1i", "l1d", "l2") for f in _CACHE_STRUCT}
   | {f"{n}.atac.{f}" for n in ("net_user", "net_memory")
      for f in _ATAC_STRUCT})

@@ -13,11 +13,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.mark.slow
 def test_two_process_mesh():
     """Both ranks run one fused megastep over an 8-device global mesh and
-    agree on the global cursor reduction."""
+    agree on the global cursor reduction.  Where the backend cannot run
+    cross-process computations at all (the CPU backend refuses with
+    "Multiprocess computations aren't implemented"), the orchestrator's
+    up-front probe reports an actionable skip — surfaced here as a
+    pytest skip carrying the backend's own reason, not a failure."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "multihost_dryrun.py")],
-        capture_output=True, text=True, timeout=900)
+        capture_output=True, text=True, timeout=1200)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+    skip = [line for line in proc.stdout.splitlines()
+            if line.startswith("MULTIHOST DRYRUN SKIPPED")]
+    if skip:
+        pytest.skip(skip[0])
     assert "MULTIHOST DRYRUN PASSED" in proc.stdout
     sums = [line.split("cursor_sum=")[1].strip()
             for line in proc.stdout.splitlines() if "cursor_sum=" in line]

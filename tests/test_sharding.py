@@ -45,3 +45,204 @@ def test_dryrun_multichip_entry():
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+# ---------------------------------------------------------------- round 11
+# Explicit shard_map path (tpu/tile_shards): the engine itself is wrapped
+# over the mesh, the window walk runs per-shard with zero cross-device
+# traffic, and the contract is BIT-identity against tile_shards=1 — every
+# state leaf, every counter, every phase counter.
+
+import dataclasses
+
+import pytest
+
+from graphite_tpu.engine.sim import Simulator
+
+
+def _params(tiles: int, shards: int, **sets):
+    cfg = load_config()
+    cfg.set("general/total_cores", tiles)
+    cfg.set("tpu/tile_shards", str(shards))
+    for k, v in sets.items():
+        cfg.set(k.replace("__", "/"), v)
+    return SimParams.from_config(cfg)
+
+
+def _assert_states_equal(a, b):
+    """Every leaf of two SimStates, by name (nested counters included)."""
+    for name in a._fields:
+        x, y = getattr(a, name), getattr(b, name)
+        if hasattr(x, "_fields"):
+            for f in x._fields:
+                u, v = getattr(x, f), getattr(y, f)
+                if u is None:
+                    assert v is None, f"{name}.{f}"
+                    continue
+                assert np.array_equal(np.asarray(u), np.asarray(v)), \
+                    f"{name}.{f}"
+            continue
+        if x is None:
+            assert y is None, name
+            continue
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+
+def _run_pair(trace, tiles: int, **sets):
+    """Run the SAME trace with tile_shards=8 and =1; return both sims."""
+    sharded = Simulator(_params(tiles, 8, **sets), trace)
+    sharded.run()
+    solo = Simulator(_params(tiles, 1, **sets), trace)
+    solo.run()
+    return sharded, solo
+
+
+def test_tile_shards_bit_identity_radix():
+    trace = synth.gen_radix(num_tiles=64, keys_per_tile=8, radix=16,
+                            seed=3)
+    sharded, solo = _run_pair(trace, 64)
+    _assert_states_equal(sharded.state, solo.state)
+
+
+def test_tile_shards_bit_identity_fft():
+    # T=8 with 8 shards: one tile per shard, the degenerate slice width.
+    trace = synth.gen_fft(num_tiles=8, points_per_tile=32)
+    sharded, solo = _run_pair(trace, 8)
+    _assert_states_equal(sharded.state, solo.state)
+
+
+@pytest.mark.slow   # two T=256 compiles
+def test_tile_shards_bit_identity_large():
+    trace = synth.gen_radix(num_tiles=256, keys_per_tile=8, radix=32,
+                            seed=5)
+    sharded, solo = _run_pair(trace, 256)
+    _assert_states_equal(sharded.state, solo.state)
+
+
+def test_tile_shards_checkpoint_reshard(tmp_path):
+    """A checkpoint written by a SHARDED run restores into an UNSHARDED
+    simulator (and finishes bit-identically to the never-sharded run):
+    checkpoint shapes are tile_shards-independent, so resharding on
+    restore is just loading with different params."""
+    trace = synth.gen_radix(num_tiles=64, keys_per_tile=8, radix=16,
+                            seed=6)
+    p8, p1 = _params(64, 8), _params(64, 1)
+
+    full = Simulator(p1, trace)
+    s_full = full.run()
+
+    half = Simulator(p8, trace)
+    half.run(max_steps=2)
+    ck = str(tmp_path / "ck_shard8.npz")
+    half.save_checkpoint(ck)
+
+    resumed = Simulator(p1, trace)       # reshard: 8 -> 1
+    resumed.restore_checkpoint(ck)
+    s_res = resumed.run()
+
+    assert s_full.completion_time_ps == s_res.completion_time_ps
+    _assert_states_equal(full.state, resumed.state)
+
+    back = Simulator(p8, trace)          # and back: the 1-shard ckpt
+    full.save_checkpoint(str(tmp_path / "ck_shard1.npz"))
+    back.restore_checkpoint(str(tmp_path / "ck_shard1.npz"))
+    _assert_states_equal(full.state, back.state)
+
+
+def test_tile_shards_structural_no_cross_shard_traffic():
+    """The CPU-checkable form of the scale-out claim (PROFILE.md round
+    11): the per-shard window phase contains ZERO collective primitives
+    and no full-T aval (every tile axis is T/S), while the whole sharded
+    megastep carries only the small bounded set of explicit collectives
+    (the WindowOut all_gathers + the quantum pmin barrier).  T=48 so the
+    tile count collides with no structural dim (bp table entries = 64).
+    """
+    from graphite_tpu.engine import core, quantum
+    from graphite_tpu.engine.kernels import dispatch
+    from graphite_tpu.engine.kernels import window as kwindow
+    from graphite_tpu.engine.vparams import variant_params
+
+    T, S = 48, 8
+    TL = T // S
+    p8 = _params(T, S)
+    p1 = dataclasses.replace(p8, tile_shards=1)
+    trace = synth.gen_radix(num_tiles=T, keys_per_tile=8, radix=16,
+                            seed=7)
+    sim = Simulator(p1, trace)
+    vp = variant_params(p1)
+
+    # Capture the real WindowIn shapes by spying on the dispatch point.
+    captured = {}
+    orig = kwindow.run_window
+
+    def spy(params, vp2, wi, s_ids, mode):
+        captured["wi"] = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), wi)
+        captured["s_ids"] = s_ids
+        return orig(params, vp2, wi, s_ids, mode)
+
+    kwindow.run_window = spy
+    try:
+        jax.eval_shape(lambda s: core._block_retire(p1, vp, s, sim.trace),
+                       sim.state)
+    finally:
+        kwindow.run_window = orig
+    wi_spec, s_ids = captured["wi"], captured["s_ids"]
+
+    # (a) slice + walk at shard-local shapes: zero collectives.
+    def walk_local(wi):
+        wi_l = kwindow.shard_local_window_in(wi, 0, TL)
+        return kwindow.window_walk(p8, vp, wi_l, s_ids)
+
+    counts = dispatch.jaxpr_op_counts(walk_local, wi_spec)
+    assert counts["collective"] == 0, counts
+
+    # (b) no aval inside the walk carries a T-sized dim.
+    wi_l_spec = jax.eval_shape(
+        lambda wi: kwindow.shard_local_window_in(wi, 0, TL), wi_spec)
+    closed = jax.make_jaxpr(
+        lambda wi: kwindow.window_walk(p8, vp, wi, s_ids))(wi_l_spec)
+    bad = []
+
+    def scan_avals(jaxpr):
+        for eqn in jaxpr.eqns:
+            for v in list(eqn.invars) + list(eqn.outvars):
+                shape = getattr(getattr(v, "aval", None), "shape", ())
+                if T in shape:
+                    bad.append((eqn.primitive.name, shape))
+            for p in eqn.params.values():
+                subs = ([p.jaxpr] if isinstance(p, jax.core.ClosedJaxpr)
+                        else [p] if isinstance(p, jax.core.Jaxpr) else [])
+                for sub in subs:
+                    scan_avals(sub)
+
+    scan_avals(closed.jaxpr)
+    assert not bad, bad[:10]
+
+    # (c) whole-step collective budget: sharded carries a small bounded
+    # count (output all_gathers + pmin), unsharded exactly zero.
+    c8 = dispatch.jaxpr_op_counts(
+        lambda s, t: quantum.megastep(p8, s, t), sim.state, sim.trace)
+    c1 = dispatch.jaxpr_op_counts(
+        lambda s, t: quantum.megastep(p1, s, t), sim.state, sim.trace)
+    assert c1["collective"] == 0, c1
+    assert 0 < c8["collective"] <= 64, c8
+
+
+def test_tile_shards_sweep_identity():
+    """vmap x shard_map composition: a sharded sweep's lanes equal the
+    unsharded sweep's lanes, leaf for leaf."""
+    from graphite_tpu.sweep.batch import SweepSimulator
+
+    trace = synth.gen_radix(num_tiles=16, keys_per_tile=8, radix=16,
+                            seed=8)
+
+    def variants(shards):
+        return [_params(16, shards, l2__data_access_time=str(lat))
+                for lat in (8, 10, 12)]
+
+    sw8 = SweepSimulator(variants(8), trace)
+    sw8.run()
+    sw1 = SweepSimulator(variants(1), trace)
+    sw1.run()
+    _assert_states_equal(sw8.bstate, sw1.bstate)

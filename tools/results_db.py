@@ -137,6 +137,20 @@ def variants_per_sec(row: dict):
     return float(n) / float(host_s)
 
 
+def quanta_per_sec(row: dict):
+    """Scale-out throughput: simulated quanta per host second — the
+    weak-scaling curve's unit (tools/weak_scaling.py legs and the bench
+    ``radix1024_shard8`` A/B row carry it directly).  Rows from
+    different (mode, num_tiles) cells land under different workload
+    labels, so each chain compares like with like.  None when absent."""
+    q = row.get("quanta_per_s")
+    try:
+        q = float(q)
+    except (TypeError, ValueError):
+        return None
+    return q if q > 0 else None
+
+
 def _count_metric(key):
     """Lower-is-better structural count (e.g. ``lowered_window_calls``:
     pallas_call sites in the lowered window round — 1 when the phase is
@@ -159,6 +173,13 @@ COUNT_METRICS = (
     ("lowered_window_calls", _count_metric("lowered_window_calls")),
     ("lowered_resolve_scatters_on",
      _count_metric("lowered_resolve_scatters_on")),
+    # Round 11: explicit collectives in the lowered SHARDED step.  The
+    # scale-out contract is that cross-device traffic is confined to the
+    # bounded set the engine placed deliberately (the window-output
+    # all_gathers + the quantum pmin); any increase means communication
+    # leaked into a phase that was shard-local.
+    ("lowered_step_collectives",
+     _count_metric("lowered_step_collectives")),
 )
 
 
@@ -175,7 +196,8 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
     comparison point is genuinely prior."""
     metrics = (("rounds/s", rounds_per_sec), ("MIPS", _mips),
                ("variants/s", variants_per_sec),
-               ("events/round", events_per_round))
+               ("events/round", events_per_round),
+               ("quanta/s", quanta_per_sec))
     warnings = []
     for name, fn in metrics:
         new = fn(row)

@@ -315,6 +315,83 @@ else
     fi
 fi
 
+# Sharding smoke gate (ISSUE 11 CI satellite): over 8 virtual devices a
+# tiny trace must (1) run bit-identically with tpu/tile_shards=8 vs 1
+# (every state leaf), and (2) lower the PER-SHARD window phase with
+# ZERO collective primitives — the scale-out claim's structural form:
+# the walk is shard-local compute, cross-device traffic exists only in
+# the step's explicit all_gathers + pmin (counted, bounded).
+shard_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+import jax
+import numpy as np
+from graphite_tpu.config import load_config
+from graphite_tpu.engine import quantum
+from graphite_tpu.engine.kernels import dispatch
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+def params(shards):
+    cfg = load_config()
+    cfg.set("general/total_cores", 16)
+    cfg.set("tpu/tile_shards", str(shards))
+    return SimParams.from_config(cfg)
+
+trace = synth.gen_radix(16, keys_per_tile=8, radix=8)
+p8, p1 = params(8), params(1)
+s8 = Simulator(p8, trace); s8.run()
+s1 = Simulator(p1, trace); s1.run()
+leaves8 = jax.tree_util.tree_leaves(s8.state)
+leaves1 = jax.tree_util.tree_leaves(s1.state)
+assert len(leaves8) == len(leaves1)
+for a, b in zip(leaves8, leaves1):
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+c8 = dispatch.jaxpr_op_counts(
+    lambda s, t: quantum.megastep(p8, s, t), s1.state, s1.trace)
+c1 = dispatch.jaxpr_op_counts(
+    lambda s, t: quantum.megastep(p1, s, t), s1.state, s1.trace)
+assert c1["collective"] == 0, c1
+assert 0 < c8["collective"] <= 64, c8
+
+# Per-shard window phase: slice to the shard's tiles, walk — zero
+# collectives and no full-T gather (every aval's tile axis is T/S).
+from graphite_tpu.engine import core
+from graphite_tpu.engine.kernels import window as kwindow
+from graphite_tpu.engine.vparams import variant_params
+vp = variant_params(p1)
+captured = {}
+orig = kwindow.run_window
+def spy(params, vp2, wi, s_ids, mode):
+    captured["wi"] = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), wi)
+    captured["s_ids"] = s_ids
+    return orig(params, vp2, wi, s_ids, mode)
+kwindow.run_window = spy
+jax.eval_shape(lambda s: core._block_retire(p1, vp, s, s1.trace), s1.state)
+kwindow.run_window = orig
+def walk_local(wi):
+    wi_l = kwindow.shard_local_window_in(wi, 0, 16 // 8)
+    return kwindow.window_walk(p8, vp, wi_l, captured["s_ids"])
+cw = dispatch.jaxpr_op_counts(walk_local, captured["wi"])
+assert cw["collective"] == 0, cw
+print(f"SHARDING SMOKE OK (8v1 bit-identical; step collectives "
+      f"{c8['collective']} sharded / {c1['collective']} solo; "
+      f"per-shard walk 0)")
+PYEOF
+)
+shard_rc=$?
+echo "$shard_out" | tail -3
+if [ $shard_rc -ne 0 ]; then
+    echo "SHARDING SMOKE GATE FAILED"
+    fail=1
+fi
+
 if [ $fail -eq 0 ]; then
     echo "ALL MODULES PASSED"
 else

@@ -1,31 +1,32 @@
-"""Weak-scaling datapoint: the radix1024 bench row over the 8-device
-``jax.distributed`` dryrun mesh vs a single device (VERDICT #10 — the
-repo's first scale number).
+"""Weak-scaling curve for the tile-sharded engine (round 11).
 
-The bench's radix1024 row (1024 tiles, 16 keys/tile, radix 64,
-``tpu/block_events = 4``) is the largest completion-sized shape BASELINE
-scores.  This tool runs a bounded, warmed window of its quantum steps
-twice — once on one device, once tile-sharded (parallel/mesh.py) over
-an 8-device mesh, the dryrun mesh's device count — and reports quanta/s
-for each.  On CPU the collectives are loopback memcpy, so the number
-bounds coordination overhead from above rather than demonstrating ICI
-bandwidth; PROFILE.md round 7 records the measured pair.
+Each cell of the (tile_shards, num_tiles) matrix runs the radix bench
+shape (16 keys/tile, radix 64, ``tpu/block_events = 4``) for a warmed,
+bounded window of quanta through the EXPLICIT shard_map path
+(``tpu/tile_shards`` — parallel/mesh.shard_wrap; the GSPMD placement
+this tool used in round 7 is superseded, PROFILE.md round 11) and
+reports quanta per host second.  Every leg is its own subprocess with a
+clean jax runtime: on the CPU backend it forces exactly ``shards``
+virtual devices, on real accelerators it uses the devices jax exposes.
 
-Mesh legs, tried in order:
-  * two coordinator-connected processes x 4 virtual devices — the
-    ``jax.distributed`` path tools/multihost_dryrun.py exercises.  On
-    this container's jax build, cross-process ``device_put`` of
-    replicated leaves fails with "Multiprocess computations aren't
-    implemented on the CPU backend" (the dryrun itself fails the same
-    way here), so
-  * fallback: ONE process with ``--xla_force_host_platform_device_count
-    =8`` — identical mesh axes, sharding specs, and per-device
-    partitions; only the process boundary (DCN leg) is gone.
+On CPU the collectives are loopback memcpy, so the curve bounds the
+COORDINATION overhead of the sharded program from above rather than
+demonstrating ICI bandwidth; the same invocation on a TPU slice
+produces the real curve.
 
-    python tools/weak_scaling.py                 # both runs + summary
-    python tools/weak_scaling.py --single        # one-device leg only
-    python tools/weak_scaling.py --mesh8-local   # fallback mesh leg
-    python tools/weak_scaling.py --rank N        # internal (mesh rank)
+The summary is results_db-ingestible: one ``weak_scaling_shard{S}_T{T}``
+workload per cell, each carrying ``quanta_per_s`` (tools/results_db.py
+``add`` flags >20% drops per cell — like compares with like).
+
+    python tools/weak_scaling.py                     # full curve
+    python tools/weak_scaling.py --shards 1,8 --tiles 1024   # subset
+    python tools/weak_scaling.py --quanta 24 --warm 8        # window
+    python tools/weak_scaling.py --bench-shard8      # bench.py's A/B row
+    python tools/weak_scaling.py --leg S T           # internal (one cell)
+
+Env: ``GRAPHITE_WEAK_SCALING_BUDGET_S`` — wall-clock budget (default
+3600); cells starting past it emit ``kind=skipped_budget`` rows instead
+of silently shrinking the curve.
 """
 
 import json
@@ -34,166 +35,220 @@ import subprocess
 import sys
 import time
 
-PORT = 29821
-NPROC = 2
-LOCAL_DEVICES = 4
-NUM_TILES = 1024
+SHARDS = (1, 2, 4, 8)
+TILES = (1024, 4096)
 QUANTA = 24
-WARM_QUANTA = 8
+WARM = 8
+KEYS_PER_TILE = 16
+RADIX = 64
+DEFAULT_BUDGET_S = 3600.0
 
 
-def _build(params_only=False):
+def _params(tiles: int, shards: int):
     from graphite_tpu.config import load_config
     from graphite_tpu.params import SimParams
 
     cfg = load_config()
-    cfg.set("general/total_cores", NUM_TILES)
+    cfg.set("general/total_cores", tiles)
+    cfg.set("tpu/tile_shards", str(shards))
     cfg.set("tpu/block_events", 4)       # the bench radix1024 row config
     cfg.set("tpu/quanta_per_step", 1)
     return SimParams.from_config(cfg)
 
 
-def _measure(tag: str) -> dict:
-    """Run WARM_QUANTA + QUANTA quantum steps of the radix1024 shape on
-    whatever device set jax exposes; returns the timed leg's rates."""
+def _measure(shards: int, tiles: int, quanta: int, warm: int) -> dict:
+    """Warm + timed megarun window of the radix shape at one cell."""
     import jax
 
-    from graphite_tpu.engine.quantum import megastep
+    from graphite_tpu.engine.quantum import megarun
     from graphite_tpu.engine.state import TraceArrays, make_state
     from graphite_tpu.events import synth
-    from graphite_tpu.parallel.mesh import make_mesh, shard_pytree
 
-    params = _build()
-    trace = synth.gen_radix(NUM_TILES, keys_per_tile=16, radix=64)
-    mesh = make_mesh(jax.devices())
-    state = shard_pytree(make_state(params, has_capi=False), mesh,
-                         NUM_TILES)
-    tarrays = shard_pytree(TraceArrays.from_trace(trace), mesh, NUM_TILES)
-    step = jax.jit(lambda s, t: megastep(params, s, t))
-    for _ in range(WARM_QUANTA):
-        state = step(state, tarrays)
+    params = _params(tiles, shards)
+    trace = synth.gen_radix(tiles, keys_per_tile=KEYS_PER_TILE,
+                            radix=RADIX)
+    tarrays = TraceArrays.from_trace(trace)
+    state = make_state(params, has_capi=False)
+    state = megarun(params, state, tarrays, warm)
     jax.block_until_ready(state)
+    q0 = int(jax.device_get(state.ctr_quantum))
     t0 = time.perf_counter()
-    for _ in range(QUANTA):
-        state = step(state, tarrays)
+    state = megarun(params, state, tarrays, quanta)
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
-    quanta = int(jax.device_get(state.ctr_quantum))
-    cursor = int(jax.device_get(state.cursor.sum()))
+    q1 = int(jax.device_get(state.ctr_quantum))
     return {
-        "mode": tag,
+        "kind": "completed",
+        "mode": f"shard{shards}",
+        "tile_shards": shards,
         "devices": len(jax.devices()),
-        "num_tiles": NUM_TILES,
-        "timed_quanta": QUANTA,
+        "num_tiles": tiles,
+        "timed_quanta": q1 - q0,
         "seconds": round(dt, 3),
-        "quanta_per_s": round(QUANTA / dt, 3),
-        "total_quanta": quanta,
-        "cursor_sum": cursor,
+        "quanta_per_s": round((q1 - q0) / max(dt, 1e-9), 3),
+        "total_quanta": q1,
+        "cursor_sum": int(jax.device_get(state.cursor.sum())),
+        "workload": f"radix{tiles} weak-scaling window, "
+                    f"{KEYS_PER_TILE} keys/tile",
     }
 
 
-def run_single() -> dict:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-
-    jax.config.update("jax_enable_x64", True)
-    return _measure("single_device")
-
-
-def run_mesh8_local() -> dict:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8").strip()
-    import jax
-
-    jax.config.update("jax_enable_x64", True)
-    return _measure("mesh8_local")
-
-
-def run_rank(rank: int) -> None:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}").strip()
-    import jax
-
-    jax.config.update("jax_enable_x64", True)
-    jax.distributed.initialize(f"127.0.0.1:{PORT}", num_processes=NPROC,
-                               process_id=rank)
-    row = _measure(f"mesh8_rank{rank}")
-    print("WEAK_SCALING_ROW " + json.dumps(row), flush=True)
-    jax.distributed.shutdown()
-
-
-def orchestrate_mesh() -> dict:
+def _leg_env(shards: int):
+    """Clean-runtime env for one cell: scrub the driver's jax pins (same
+    workaround as tools/multihost_dryrun.py); on CPU force exactly
+    ``shards`` virtual devices."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {k: v for k, v in os.environ.items()
-           if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
-                        "PYTHONSTARTUP")}
+           if k not in ("PYTHONPATH", "XLA_FLAGS", "PYTHONSTARTUP")}
     env["PYTHONPATH"] = repo
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--rank", str(r)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env, cwd=repo)
-        for r in range(NPROC)
-    ]
-    row = None
-    ok = True
-    for r, p in enumerate(procs):
-        out, _ = p.communicate(timeout=3600)
-        ok &= p.returncode == 0
-        for line in out.splitlines():
-            if line.startswith("WEAK_SCALING_ROW ") and row is None:
-                row = json.loads(line[len("WEAK_SCALING_ROW "):])
-        if p.returncode != 0:
-            print(out[-2000:], file=sys.stderr)
-    if not ok or row is None:
-        raise RuntimeError("mesh leg failed")
-    return row
+    platform = env.setdefault("JAX_PLATFORMS", "cpu")
+    if platform == "cpu":
+        env["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={shards}").strip()
+    return repo, env
+
+
+def run_leg(shards: int, tiles: int, quanta: int, warm: int) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from graphite_tpu.compile_cache import enable_compile_cache
+    enable_compile_cache()
+    print("WEAK_SCALING_ROW "
+          + json.dumps(_measure(shards, tiles, quanta, warm)), flush=True)
+
+
+def run_bench_shard8(tiles: int = 1024, quanta: int = QUANTA,
+                     warm: int = WARM) -> None:
+    """bench.py's ``radix1024_shard8`` A/B row: the SAME process (8
+    devices) runs the cell sharded and unsharded, reports both rates
+    and whether the final states match bit for bit."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from graphite_tpu.compile_cache import enable_compile_cache
+    enable_compile_cache()
+    import jax.tree_util as jtu
+    import numpy as np
+
+    sharded = _measure(8, tiles, quanta, warm)
+    single = _measure(1, tiles, quanta, warm)
+
+    # Bit-identity on a short full run of the same shape (quanta-bounded
+    # so the check costs one more window, not a completion run).
+    from graphite_tpu.engine.quantum import megarun
+    from graphite_tpu.engine.state import TraceArrays, make_state
+    from graphite_tpu.events import synth
+
+    trace = synth.gen_radix(tiles, keys_per_tile=KEYS_PER_TILE,
+                            radix=RADIX)
+    tarrays = TraceArrays.from_trace(trace)
+
+    def short(shards):
+        p = _params(tiles, shards)
+        st = megarun(p, make_state(p, has_capi=False), tarrays, warm)
+        jax.block_until_ready(st)
+        return st
+
+    s8, s1 = short(8), short(1)
+    match = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jtu.tree_leaves(s8), jtu.tree_leaves(s1)))
+    row = {
+        "kind": "completed",
+        "num_tiles": tiles,
+        "devices": len(jax.devices()),
+        "quanta_per_s": sharded["quanta_per_s"],
+        "quanta_per_s_single": single["quanta_per_s"],
+        "shard8_vs_single": round(
+            sharded["quanta_per_s"]
+            / max(single["quanta_per_s"], 1e-9), 3),
+        "sharded_matches_single": bool(match),
+        "timed_quanta": sharded["timed_quanta"],
+        "workload": f"radix{tiles} shard8-vs-single A/B, "
+                    f"{KEYS_PER_TILE} keys/tile",
+    }
+    print("WEAK_SCALING_ROW " + json.dumps(row), flush=True)
+
+
+def _subprocess_cell(args, shards: int, timeout: float) -> dict:
+    repo, env = _leg_env(shards)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            capture_output=True, text=True, env=env, cwd=repo,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"kind": "skipped_budget",
+                "error": f"cell exceeded {timeout:.0f}s"}
+    rows = [l for l in out.stdout.splitlines()
+            if l.startswith("WEAK_SCALING_ROW ")]
+    if out.returncode != 0 or not rows:
+        return {"kind": "failed",
+                "error": (out.stdout + out.stderr)[-1500:]}
+    return json.loads(rows[-1][len("WEAK_SCALING_ROW "):])
+
+
+def bench_shard8_row(tiles: int = 1024, quanta: int = QUANTA,
+                     warm: int = WARM, timeout: float = 3300.0) -> dict:
+    """Entry point bench.py imports: the A/B cell in a fresh 8-device
+    subprocess (the bench process itself does not force virtual
+    devices)."""
+    return _subprocess_cell(
+        ["--bench-shard8", "--tiles", str(tiles), "--quanta", str(quanta),
+         "--warm", str(warm)], 8, timeout)
+
+
+def _flag(argv, name, default):
+    if name in argv:
+        return argv[argv.index(name) + 1]
+    return default
 
 
 def main() -> int:
-    if "--rank" in sys.argv:
-        run_rank(int(sys.argv[sys.argv.index("--rank") + 1]))
+    argv = sys.argv[1:]
+    quanta = int(_flag(argv, "--quanta", QUANTA))
+    warm = int(_flag(argv, "--warm", WARM))
+    if "--leg" in argv:
+        i = argv.index("--leg")
+        run_leg(int(argv[i + 1]), int(argv[i + 2]), quanta, warm)
         return 0
-    if "--single" in sys.argv:
-        print(json.dumps(run_single()))
+    if "--bench-shard8" in argv:
+        run_bench_shard8(int(_flag(argv, "--tiles", 1024)), quanta, warm)
         return 0
-    if "--mesh8-local" in sys.argv:
-        print(json.dumps(run_mesh8_local()))
-        return 0
-    # Each leg in its own subprocess so it gets a clean jax runtime.
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ, PYTHONPATH=repo)
 
-    def leg(flag):
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), flag],
-            capture_output=True, text=True, env=env, cwd=repo,
-            timeout=3600)
-        if out.returncode != 0:
-            raise RuntimeError(
-                f"{flag} leg failed:\n"
-                + out.stdout[-1500:] + out.stderr[-1500:])
-        return json.loads(out.stdout.strip().splitlines()[-1])
-
-    single = leg("--single")
-    try:
-        mesh = orchestrate_mesh()
-    except Exception as e:
-        print(f"jax.distributed mesh leg unavailable "
-              f"({str(e).splitlines()[-1][:120]}); using the "
-              f"single-process 8-device mesh", file=sys.stderr)
-        mesh = leg("--mesh8-local")
-    summary = {
-        "single_device": single,
-        "mesh8": mesh,
-        "mesh8_vs_single_quanta_per_s": round(
-            mesh["quanta_per_s"] / max(single["quanta_per_s"], 1e-9), 3),
-    }
-    print(json.dumps(summary))
+    shards = [int(s) for s in
+              str(_flag(argv, "--shards",
+                        ",".join(map(str, SHARDS)))).split(",")]
+    tiles = [int(t) for t in
+             str(_flag(argv, "--tiles",
+                       ",".join(map(str, TILES)))).split(",")]
+    budget_s = float(os.environ.get("GRAPHITE_WEAK_SCALING_BUDGET_S",
+                                    str(DEFAULT_BUDGET_S)))
+    t_start = time.monotonic()
+    detail = {}
+    for t in tiles:
+        for s in shards:
+            label = f"weak_scaling_shard{s}_T{t}"
+            elapsed = time.monotonic() - t_start
+            if elapsed > budget_s:
+                detail[label] = {"kind": "skipped_budget",
+                                 "elapsed_s": round(elapsed, 1),
+                                 "budget_s": budget_s}
+                print(f"{label}: skipped_budget", file=sys.stderr,
+                      flush=True)
+                continue
+            row = _subprocess_cell(
+                ["--leg", str(s), str(t), "--quanta", str(quanta),
+                 "--warm", str(warm)],
+                s, timeout=max(budget_s - elapsed, 60.0))
+            detail[label] = row
+            print(f"{label}: {row.get('quanta_per_s', row['kind'])}",
+                  file=sys.stderr, flush=True)
+    print(json.dumps({"metric": "weak_scaling", "detail": detail}))
     return 0
 
 
