@@ -125,6 +125,13 @@ def _sweep_command(cfg, args) -> int:
             "quanta": d["quanta"],
             "aggregate": d["aggregate"],
         }
+        # Round-12 adaptive-fidelity attribution rides the variant rows
+        # when tpu/fast_forward > 0, so `results_db.py add` chains the
+        # ff-quanta-fraction regression flag over sweep output too.
+        for k in ("ff_rounds", "ff_quanta", "ff_events",
+                  "ff_quanta_frac"):
+            if k in d:
+                detail[label][k] = d[k]
         print(f"{label}: completion "
               f"{ps_to_ns(s.completion_time_ps):.1f} ns, "
               f"{'done' if d['all_done'] else 'INCOMPLETE'}, "

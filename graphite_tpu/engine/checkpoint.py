@@ -25,7 +25,11 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 23  # v23: round-9 fan-out chain replay — carried
+_SCHEMA_VERSION = 24  # v24: round-12 adaptive-fidelity fast-forward —
+#   the analytic-span attribution scalars (ctr_ff/ctr_ffq/ff_events)
+#   join the phase-counter block so a mid-fast-forward checkpoint
+#   resumes with exact round/quantum accounting;
+#   v23: round-9 fan-out chain replay — carried
 #   window occupancy widens the win_* cache arrays to [.., 4K] (partial
 #   windows survive quantum cuts instead of forcing a refresh) and the
 #   chain_fanout_served / chain_fallback counters land in Counters;

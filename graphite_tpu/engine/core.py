@@ -73,6 +73,7 @@ STAMP_STRIDE = params_mod.STAMP_STRIDE
 _lat = kwindow._lat
 _row_word = kwindow._row_word
 _spanned_bound = kwindow._spanned_bound
+_ff_bound = kwindow._ff_bound
 
 
 def _period(state: SimState, module: DVFSModule):
@@ -111,15 +112,17 @@ def _window_slice_gather(st: SimState, trace: TraceArrays, width: int):
 
 
 def _window_refresh(params: SimParams, st: SimState, trace: TraceArrays,
-                    tile_active: jnp.ndarray) -> SimState:
+                    tile_active: jnp.ndarray,
+                    width: int = None) -> SimState:
     """Quantum-scoped window cache (tpu/window_cache): re-gather the
     [T, WC] resident slice only when some ACTIVE tile's next-K events
     fall outside its cached span (cursor advanced past win_base + WC - K,
     restored state, or a seat rotation).  The guard is a scalar
     ``lax.cond`` whose operands are just the window arrays — cache-hit
     rounds pay an elementwise validity check instead of a full-trace
-    gather."""
-    K = params.block_events
+    gather.  ``width`` overrides the required resident span (round-12
+    wide fast-forward rounds need ``_ff_width`` events)."""
+    K = params.block_events if width is None else width
     WC = st.win_meta.shape[2]
     d = st.cursor - st.win_base
     ok = (d >= 0) & (d + K <= WC)
@@ -140,8 +143,134 @@ def _window_refresh(params: SimParams, st: SimState, trace: TraceArrays,
     return st._replace(win_meta=wm, win_addr=wa, win_base=wb, win_seat=ws)
 
 
+def _ff_width(params: SimParams) -> int:
+    """Fast-forward span width in EVENTS (0 = leg compiled out).
+
+    ``tpu/fast_forward`` counts block_events-sized windows; the width is
+    clipped so one round's per-event stamps fit its exclusive
+    STAMP_STRIDE allocation — it sizes BOTH fast-forward surfaces: the
+    wide window rounds of the local cadence and the analytic run-ahead
+    span.  A width of one window can never beat the narrow round it
+    replaces, so the multiplier floors at 2 — and when K > STRIDE/2 no
+    legal width can beat a narrow round, which disables the leg
+    statically."""
+    K = params.block_events
+    if params.fast_forward <= 0 or K <= 0:
+        return 0
+    cap = STAMP_STRIDE // K
+    if cap < 2:
+        return 0
+    return K * min(max(params.fast_forward, 2), cap)
+
+
+def _fast_forward_retire(params: SimParams, vp: VariantParams,
+                         st: SimState, trace: TraceArrays,
+                         cand: jnp.ndarray) -> SimState:
+    """One analytic fast-forward round (round 12): gather each candidate
+    tile's next ``_ff_width`` events, price the longest hit/compute-only
+    prefix in closed form (kernels/window.fast_forward_walk — shared
+    with the Pallas and sharded paths exactly like the window walk),
+    and land clock/cursor/cache/predictor/counter effects in one apply.
+
+    The gather reads the TRACE directly (``_window_slice_gather``)
+    rather than the resident window cache: an engaged span sweeps up to
+    the cache's whole width, so the residual resident slice past the
+    cursor almost never covers it — while the detection itself (probes
+    vs resident cache state) is exactly the window's.  ``round_ctr``
+    advances only when some tile ENGAGES (a span crossing the window
+    bound into the ``fast_forward_span`` run-ahead budget): a declined
+    probe uses no stamps, so reusing its round_ctr value is exact."""
+    F = _ff_width(params)
+    N = trace.num_events
+    meta, addr = _window_slice_gather(st, trace, F)
+    pos = st.cursor[:, None] + jnp.arange(F, dtype=jnp.int32)[None, :]
+    valid_ev = (pos < N) & cand[:, None]
+    fi = kwindow.FFIn(
+        meta=meta, addr=addr, valid_ev=valid_ev, tile_active=cand,
+        clock=st.clock, period_ps=st.period_ps, bp_table=st.bp_table,
+        l1i_word=st.l1i.word, l1d_word=st.l1d.word,
+        boundary=st.boundary, models_enabled=st.models_enabled,
+        stamp_base=_stamp_base(st))
+    mode = kdispatch.window_mode(params)
+    if params.tile_shards > 1:
+        out = kwindow.run_fast_forward_sharded(params, vp, fi, mode)
+    else:
+        out = kwindow.run_fast_forward(params, vp, fi, mode)
+
+    any_engage = (out.n_ret > 0).any()
+    c = st.counters
+    c = c._replace(**{
+        name: getattr(c, name) + out.ctr_inc[i]
+        for i, name in enumerate(kwindow.WINDOW_CTRS)})
+    return st._replace(
+        clock=out.clock,
+        cursor=st.cursor + out.n_ret,
+        l1i=st.l1i._replace(word=out.l1i_word),
+        l1d=st.l1d._replace(word=out.l1d_word),
+        bp_table=out.bp_table,
+        counters=c,
+        round_ctr=st.round_ctr + any_engage.astype(jnp.int32),
+        ctr_ff=st.ctr_ff + any_engage.astype(jnp.int64),
+        ff_events=st.ff_events + jnp.sum(out.n_ret).astype(jnp.int64),
+    )
+
+
+def _fast_forward_guarded(params: SimParams, vp: VariantParams,
+                          state: SimState,
+                          trace: TraceArrays) -> SimState:
+    """Adaptive cadence gate for the fast-forward leg: statically
+    compiled out at ``fast_forward`` 0 (bit-identity with the
+    pre-round-12 engine), under iocoom (RAW floors disqualify the
+    closed form), with the ThreadScheduler seated (rotation boundaries
+    are thread-switch events the span must not cross), or when no span
+    could beat a window round (``_ff_width`` == 0).  Otherwise: price
+    run-ahead spans (commits past the window bound, admitted by the
+    ``fast_forward_span`` budget) until no tile engages, then fall back
+    to the detailed machinery — whose window rounds at fast_forward > 0
+    are the WIDE in-bound surface of the same leg."""
+    if _ff_width(params) == 0 or params.core.model == "iocoom" \
+            or state.sched_enabled:
+        return state
+    N = trace.num_events
+    P = params.miss_chain
+
+    def cand_of(s):
+        c = (~s.done) & (s.pend_kind == PEND_NONE) & (s.cursor < N) \
+            & (s.clock < _ff_bound(params, vp, s.boundary))
+        if P > 0:
+            c = c & (s.mq_count == 0)       # pending chain heads decline
+        return c
+
+    def prog(s):
+        return jnp.sum(s.cursor.astype(jnp.int64))
+
+    cap = max(1, params.max_events_per_quantum)
+
+    def fcond(carry):
+        i, pv, cv, _s = carry
+        return (i < cap) & ((i == 0) | (cv > pv))
+
+    def fbody(carry):
+        i, _pv, cv, s = carry
+        s = _fast_forward_retire(params, vp, s, trace, cand_of(s))
+        return i + 1, cv, prog(s), s
+
+    def floop(s):
+        _, _, _, out = jax.lax.while_loop(
+            fcond, fbody, (jnp.int32(0), jnp.int64(-1), prog(s), s))
+        return out
+
+    # At span 0 the walk's engage rule (commits past the window bound)
+    # provably never fires — skip the probe outright.  ``span_ps`` is a
+    # VARIANT operand, so the gate is a runtime scalar and sweep lanes
+    # with mixed spans stay one program.
+    return jax.lax.cond(cand_of(state).any() & state.models_enabled
+                        & (vp.fast_forward_span_ps > 0),
+                        floop, lambda s: s, state)
+
+
 def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
-                  trace: TraceArrays) -> SimState:
+                  trace: TraceArrays, width: int = None) -> SimState:
     """Retire the leading run of simple events in each tile's [K] window.
 
     This function is the gather/apply shell: it assembles the window
@@ -156,8 +285,16 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
     gridded over tile blocks (interpret / tpu modes), bit-identical by
     construction.  See kernels/window.py for the walk semantics and the
     round-7/9 blocking-chain commentary.
+
+    ``width`` (round 12, ``tpu/fast_forward`` > 0) widens the window to
+    ``_ff_width`` events: the UNCHANGED walk — probes, hazards, chain
+    banking, the max-plus prefix — runs over a [T, width] slice, so one
+    wide round retires the run + banks the misses that several narrow
+    rounds would have, which is where the fast-forward round-count drop
+    comes from.  The walk is width-polymorphic by construction
+    (kernels/window.py), so wide and narrow rounds cannot drift.
     """
-    K = params.block_events
+    K = params.block_events if width is None else width
     T = params.num_tiles
     N = trace.num_events
     P = params.miss_chain
@@ -182,8 +319,8 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
     # tile outruns it) — values are bit-identical to the direct gather.
     pos = st.cursor[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
     valid_ev = (pos < N) & tile_active[:, None]
-    if st.win_meta.shape[2] > 0:
-        st = _window_refresh(params, st, trace, tile_active)
+    if st.win_meta.shape[2] >= K:
+        st = _window_refresh(params, st, trace, tile_active, width=K)
         WC = st.win_meta.shape[2]
         # Post-refresh every ACTIVE tile's offset is in bounds; inactive
         # tiles clamp and read junk that valid_ev masks (exactly the junk
@@ -258,6 +395,15 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
             mq_count=out.mq_count,
             chain_rel=out.chain_rel,
         )
+    if width is not None and width > params.block_events:
+        # Round-12 attribution: a wide fast-forward round counts when it
+        # retires MORE than one narrow round's per-tile capacity — the
+        # events a detailed round could not have priced.  ctr_ffq
+        # derives from ctr_ff growth at the quantum layer.
+        gain = jnp.maximum(out.n_ret - params.block_events, 0)
+        st = st._replace(
+            ctr_ff=st.ctr_ff + (gain > 0).any().astype(jnp.int64),
+            ff_events=st.ff_events + jnp.sum(gain).astype(jnp.int64))
     return st
 
 
@@ -906,6 +1052,24 @@ def local_advance(params: SimParams, state: SimState,
     callers outside the sweep engine need not change."""
     if vp is None:
         vp = variant_params(params)
+    # Round-12 adaptive fidelity: try the analytic fast-forward FIRST
+    # each sub-round — run-ahead spans (the ``fast_forward_span``
+    # budget) are priced in closed form and the detailed machinery
+    # below resumes at the first disqualifying event.  Statically
+    # absent at fast_forward = 0.
+    if params.fast_forward > 0:
+        state = _fast_forward_guarded(params, vp, state, trace)
+    # Wide fast-forward WINDOW rounds: at fast_forward > 0 every window
+    # round below runs the unchanged walk over an ``_ff_width`` slice
+    # instead of [T, K] — one round retires the hit run AND banks the
+    # misses that several narrow rounds would have, so sub-rounds drain
+    # more events per resolve pass and the round count drops (the
+    # acceptance multiplier).  Static per compile; disabled under
+    # iocoom and the ThreadScheduler exactly like the analytic leg.
+    wide = _ff_width(params)
+    if wide <= params.block_events or params.core.model == "iocoom" \
+            or state.sched_enabled:
+        wide = None
     if params.miss_chain > 0:
         if params.block_events > 0:
             # Enough window rounds per sub-round to fill the chain bank
@@ -916,7 +1080,7 @@ def local_advance(params: SimParams, state: SimState,
             # candidates chain-full or past the quantum boundary — the
             # window's own in_b gate would mask every event, so the
             # skip is result-identical and saves the probe round).
-            K = params.block_events
+            K = params.block_events if wide is None else wide
             cap_w = max(1, -(-params.miss_chain * 3 // (2 * K)))
             N = trace.num_events
             qps = vp.quantum_ps
@@ -959,7 +1123,7 @@ def local_advance(params: SimParams, state: SimState,
 
             def wbody(c):
                 j, _pv, cv, _more, s = c
-                s = _block_retire(params, vp, s, trace)
+                s = _block_retire(params, vp, s, trace, width=wide)
                 return j + 1, cv, wprog(s), wmore(s), s
 
             def wloop(st):
@@ -997,7 +1161,7 @@ def local_advance(params: SimParams, state: SimState,
 
             def wbody(c):
                 j, _pv, cv, s = c
-                s = _block_retire(params, vp, s, trace)
+                s = _block_retire(params, vp, s, trace, width=wide)
                 return j + 1, cv, progress(s), s
 
             _, _, _, st = jax.lax.while_loop(

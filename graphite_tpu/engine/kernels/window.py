@@ -143,14 +143,37 @@ def _spanned_bound(params: SimParams, vp, boundary):
     return boundary
 
 
+def _ff_bound(params: SimParams, vp, boundary):
+    """Round-12 fast-forward bound: the analytic span commits events
+    whose pre-clock stays under the same (possibly quantum-spanned)
+    bound the window's per-event prefix enforces, PLUS the VARIANT
+    run-ahead budget ``tpu/fast_forward_span`` — Graphite's lax-sync
+    trade scoped to the closed-form leg.  At span 0 the bound equals
+    the window's exactly, so fast-forwarded tiles stop where detailed
+    rounds would.  ONE definition (core.py aliases it) so the cadence
+    gate and the walk's commit mask can never drift apart."""
+    b = _spanned_bound(params, vp, boundary)
+    if params.fast_forward > 0:
+        span = vp.fast_forward_span_ps if vp is not None \
+            else jnp.int64(params.fast_forward_span_ps)
+        return b + span
+    return b
+
+
 def window_walk(params: SimParams, vp: VariantParams, wi: WindowIn,
                 s_ids: int) -> WindowOut:
     """Classify + retire one [TL, K] window (TL = full T on the lax
     path, one tile block inside the kernel).  Pure: reads only ``wi``,
     returns every effect.  The body is engine/core._block_retire's walk,
     verbatim apart from the input plumbing — see that docstring for the
-    semantics commentary."""
-    K = params.block_events
+    semantics commentary.
+
+    Width-polymorphic like the tile axis: K is the EVENT axis of the
+    operands, normally ``params.block_events`` but ``core._ff_width``
+    events for a round-12 wide fast-forward round (``tpu/fast_forward``
+    > 0) — the same walk, probing/banking/hazarding over a longer
+    window, so the wide rounds can never drift from the narrow ones."""
+    K = wi.addr.shape[1]
     TL = wi.clock.shape[0]               # LOCAL tile count (block size)
     P = params.miss_chain
     line_bits = params.line_size.bit_length() - 1
@@ -708,3 +731,301 @@ def run_window_sharded(params: SimParams, vp: VariantParams, wi: WindowIn,
 
     return WindowOut(**{f: gather(f, v)
                         for f, v in zip(WindowOut._fields, out_l)})
+
+
+# ------------------------------------------------ round-12 fast-forward
+
+class FFIn(NamedTuple):
+    """Fast-forward-walk operands: the hit/compute-only subset of the
+    window operands over an [T, F] span (F = core._ff_width windows'
+    worth of events).  No chain state, no iocoom rings, no L2, no rr
+    pointers — the leg statically excludes every event class that could
+    need them."""
+
+    meta: jnp.ndarray           # [3, T, F] int32 (op, arg, arg2)
+    addr: jnp.ndarray           # [T, F] int64
+    valid_ev: jnp.ndarray       # [T, F] bool (pos < N & candidate)
+    tile_active: jnp.ndarray    # [T] bool fast-forward candidates
+    clock: jnp.ndarray          # [T] int64
+    period_ps: jnp.ndarray      # [T, NUM_DVFS_MODULES] int32
+    bp_table: jnp.ndarray       # [T, bp_size] bool
+    l1i_word: jnp.ndarray       # [A, T, sets] int64
+    l1d_word: jnp.ndarray       # [A, T, sets] int64
+    boundary: jnp.ndarray       # [] int64
+    models_enabled: jnp.ndarray  # [] bool
+    stamp_base: jnp.ndarray     # [] int32
+
+
+FF_IN_AXES = dict(
+    meta=1, addr=0, valid_ev=0, tile_active=0, clock=0, period_ps=0,
+    bp_table=0, l1i_word=1, l1d_word=1, boundary=None,
+    models_enabled=None, stamp_base=None,
+)
+
+
+class FFOut(NamedTuple):
+    clock: jnp.ndarray          # [T] int64
+    n_ret: jnp.ndarray          # [T] int32 (0 on every non-engaged tile)
+    bp_table: jnp.ndarray       # [T, bp_size] bool
+    l1i_word: jnp.ndarray       # [A, T, sets] int64 (touch stamps only)
+    l1d_word: jnp.ndarray       # [A, T, sets] int64
+    ctr_inc: jnp.ndarray        # [len(WINDOW_CTRS), T] int64
+
+
+FF_OUT_AXES = dict(clock=0, n_ret=0, bp_table=0, l1i_word=1, l1d_word=1,
+                   ctr_inc=1)
+
+
+def fast_forward_walk(params: SimParams, vp: VariantParams,
+                      fi: FFIn) -> FFOut:
+    """Price the longest hit/compute-only event prefix of each candidate
+    tile in CLOSED FORM (round-12, ``tpu/fast_forward``).
+
+    Eligible events are exactly the window classes whose pricing reads
+    nothing an earlier in-span event can change: COMPUTE with an L1I
+    hit, BRANCH, and MEM reads/writes with a writable L1D hit.  Pure
+    hits install no lines — touches move stamps (not tags) and the MESI
+    E->M upgrade never changes hit-ness or writability — so probing the
+    whole span against SPAN-START cache state yields the identical
+    hits, dts, and counters the detailed window rounds would produce
+    event by event.  With no stall/sync floors in the span, the
+    window's max-plus prefix degenerates to a cumulative sum, so the
+    span's clock advance, commit cut (pre-clock < ``_ff_bound``), and
+    counter accumulation are all one reduction instead of F engine
+    rounds.  Within-span branch-predictor RAW forwards the last earlier
+    committed write per table slot — the same rule the window applies
+    within a round and the table carries across rounds, fused over the
+    span (commits form a prefix, so writer visibility is exact).
+
+    A tile ENGAGES only when its committable prefix beats one detailed
+    window round (n_commit > K); otherwise the walk returns it
+    untouched and the detailed machinery proceeds — the fall-back rule
+    of the adaptive cadence.  Committed spans write the same LRU-touch
+    scatter-max, E->M upgrades (propagated sticky within the span, so a
+    later read of an upgraded line carries M exactly as a post-upgrade
+    window probe would), and predictor-table winners the window rounds
+    would have.  Pure and per-tile independent like ``window_walk`` —
+    the same function serves the lax path, the fused Pallas kernel, and
+    the shard-sliced path."""
+    K = params.block_events
+    TL = fi.clock.shape[0]
+    F = fi.addr.shape[1]
+    line_bits = params.line_size.bit_length() - 1
+    mesi_local = params.protocol_kind == "sh_l2_mesi"
+    rows = jnp.arange(TL)
+
+    l1i = cachemod.CacheArrays(word=fi.l1i_word, rr_ptr=None)
+    l1d = cachemod.CacheArrays(word=fi.l1d_word, rr_ptr=None)
+
+    valid_ev = fi.valid_ev
+    op, arg, arg2 = fi.meta[0], fi.meta[1], fi.meta[2]
+    op = jnp.where(valid_ev, op, EventOp.NOP)
+    en = fi.models_enabled
+
+    p_core = fi.period_ps[:, int(DVFSModule.CORE)][:, None]
+    p_l1i = fi.period_ps[:, int(DVFSModule.L1_ICACHE)][:, None]
+    p_l1d = fi.period_ps[:, int(DVFSModule.L1_DCACHE)][:, None]
+    l1i_ps = _lat(vp.l1i_access_cycles, p_l1i)
+    l1d_ps = _lat(vp.l1d_access_cycles, p_l1d)
+    cycle_ps = _lat(1, p_core)
+
+    line = fi.addr >> line_bits
+    is_comp = op == EventOp.COMPUTE
+    is_br = op == EventOp.BRANCH
+    is_rd = op == EventOp.MEM_READ
+    is_wr = op == EventOp.MEM_WRITE          # atomics stay complex
+    is_mem = is_rd | is_wr
+
+    # ---- span-start probes; eligibility = the miss-free window classes
+    pI = cachemod.probe(l1i, line, params.l1i.num_sets)
+    pD = cachemod.probe(l1d, line, params.l1d.num_sets)
+    writable = pD.state >= (E if mesi_local else M)
+    l1_ok = pD.hit & (is_rd | writable)
+    elig = ((is_comp & pI.hit) | is_br | (is_mem & l1_ok)) \
+        & valid_ev & fi.tile_active[:, None] & en
+    # Leading eligible run (integer cumsum, not cumprod — the engine is
+    # all-integer and the Pallas path lowers it as such).
+    lead = jnp.cumsum((~elig).astype(jnp.int32), axis=1) == 0
+
+    ar = jnp.arange(F)
+    earlier = ar[None, :, None] > ar[None, None, :]           # [1, F, F]
+
+    # ---- branch predictor: last earlier in-lead write per slot wins
+    # (fuses the window's within-round RAW with its cross-round table
+    # reads; exact because commits are a prefix of ``lead``).
+    if params.core.bp_type == "none":
+        correct = jnp.ones_like(is_br)
+        bidx = None
+    else:
+        bidx = (fi.addr % params.core.bp_size).astype(jnp.int32)
+        tbl_pred = jnp.take_along_axis(fi.bp_table, bidx, axis=1)
+        same_slot = bidx[:, :, None] == bidx[:, None, :]      # [T, Fj, Fi]
+        taken = arg != 0
+        w_mask = earlier & same_slot & (is_br & lead)[:, None, :]
+        has_w = w_mask.any(axis=2)
+        last_w = jnp.argmax(
+            jnp.where(w_mask, ar[None, None, :], -1), axis=2)
+        pred = jnp.where(has_w, jnp.take_along_axis(taken, last_w, axis=1),
+                         tbl_pred)
+        correct = pred == taken
+
+    # ---- per-event dt — the window's formulas with every fill/L2/floor
+    # term structurally zero for the eligible classes.
+    icount_ev = jnp.maximum(arg2 & ((1 << 20) - 1), 0).astype(jnp.int64)
+    cost_ps = _lat(jnp.maximum(arg, 0), p_core)
+    dt = jnp.zeros((TL, F), dtype=jnp.int64)
+    dt = jnp.where(is_comp, cost_ps + icount_ev * l1i_ps, dt)
+    dt = jnp.where(is_br,
+                   jnp.where(correct, cycle_ps,
+                             _lat(vp.bp_mispredict_penalty, p_core))
+                   + l1i_ps, dt)
+    dt = jnp.where(is_mem, l1d_ps, dt)
+
+    # ---- closed-form commit: clock BEFORE event j under the bound.
+    bound = _ff_bound(params, vp, fi.boundary)
+    dtm = jnp.where(lead, dt, 0)
+    csum = jnp.cumsum(dtm, axis=1)
+    pre = fi.clock[:, None] + csum - dtm
+    commit0 = lead & (pre < bound)           # dt >= 0 => still a prefix
+    n_commit = jnp.sum(commit0, axis=1).astype(jnp.int32)
+    # A tile engages only when the span prices RUN-AHEAD the detailed
+    # machinery cannot reach: commits past the window's own (possibly
+    # quantum-spanned) bound, admitted by the ``fast_forward_span``
+    # budget alone.  At span 0 ``bound`` equals the window bound, no
+    # commit can cross it, and the leg stays dormant — within-bound
+    # work belongs to the wide fast-forward WINDOW rounds (core.py
+    # cadence), which price it without an extra round.
+    wb = _spanned_bound(params, vp, fi.boundary)
+    engage = fi.tile_active & (n_commit > K) \
+        & (commit0 & (pre >= wb)).any(axis=1)
+    commit = commit0 & engage[:, None]
+    n_ret = jnp.where(engage, n_commit, 0)
+    clock = fi.clock + jnp.sum(jnp.where(commit, dt, 0), axis=1)
+
+    # ---- batched LRU touches (stamps keep within-span order; all span
+    # stamps exceed every pre-span stamp, so relative LRU age is the
+    # window rounds' exactly).
+    stamp = (fi.stamp_base + ar)[None, :]
+    l1i = cachemod.touch(l1i, pI.set_idx, pI.way, is_comp & commit,
+                         _row_word(pI.row, pI.way), stamp)
+    d_word = _row_word(pD.row, pD.way)
+    if mesi_local:
+        # Sticky E->M: any committed earlier-or-self write of the line
+        # upgrades every later in-span touch word of that line, so the
+        # scatter-max lands M exactly as post-upgrade window probes
+        # would have.
+        ge = ar[None, :, None] >= ar[None, None, :]
+        same_line_f = line[:, :, None] == line[:, None, :]
+        upgraded = (ge & same_line_f & (commit & is_wr)[:, None, :]
+                    ).any(axis=2) & (pD.state == E)
+        d_word = cachemod.with_state(
+            d_word, jnp.where(is_mem & upgraded, M, pD.state))
+    l1d = cachemod.touch(l1d, pD.set_idx, pD.way, is_mem & commit,
+                         d_word, stamp)
+
+    # ---- predictor table: last committed write per slot wins (the
+    # window's winner rule over the span; dense-vs-scatter keyed on the
+    # GLOBAL T like the window, so lax and blocked paths agree).
+    bp_table = fi.bp_table
+    if bidx is not None:
+        wr_ev = is_br & commit
+        later_same = (earlier.transpose(0, 2, 1) & same_slot
+                      & wr_ev[:, None, :]).any(axis=2)
+        winner = wr_ev & ~later_same
+        SZ = params.core.bp_size
+        if params.num_tiles * F * SZ <= dense.DENSE_MAX_ELEMS:
+            oh = (bidx[:, :, None]
+                  == jnp.arange(SZ, dtype=jnp.int32)[None, None, :]) \
+                & winner[:, :, None]
+            wrote = oh.any(axis=1)
+            val = (oh & taken[:, :, None]).any(axis=1)
+            bp_table = jnp.where(wrote, val, bp_table)
+        else:
+            bp_table = bp_table.at[
+                rows[:, None], jnp.where(winner, bidx, SZ)
+            ].set(taken, mode="drop")
+
+    # ---- counters: the window's rows with every miss/L2/spawn term
+    # structurally zero.
+    def msum(mask, val=1):
+        v = jnp.asarray(val)
+        v = jnp.broadcast_to(v, (TL, F)) if v.ndim < 2 else v
+        return jnp.sum(jnp.where(mask & commit, v.astype(jnp.int64), 0),
+                       axis=1)
+
+    zero = jnp.zeros(TL, dtype=jnp.int64)
+    ctr_inc = jnp.stack([
+        msum(is_comp, icount_ev)
+        + msum((is_mem & ((arg2 & 0xFF) == 0)) | is_br),     # icount
+        msum(is_comp, icount_ev) + msum(is_br),              # l1i_access
+        zero,                                                # l1i_miss
+        msum(is_rd),                                         # l1d_read
+        zero,                                                # l1d_read_miss
+        msum(is_wr),                                         # l1d_write
+        zero,                                                # l1d_write_miss
+        zero,                                                # l2_access
+        zero,                                                # l2_miss
+        msum(is_br),                                         # branches
+        msum(is_br & ~correct),                              # mispredicts
+        zero,                                                # spawns
+    ])
+
+    return FFOut(clock=clock, n_ret=n_ret, bp_table=bp_table,
+                 l1i_word=l1i.word, l1d_word=l1d.word, ctr_inc=ctr_inc)
+
+
+def run_fast_forward(params: SimParams, vp: VariantParams, fi: FFIn,
+                     mode: str) -> FFOut:
+    """Dispatch the fast-forward walk: inline lax ('off') or one fused
+    pallas_call gridded over tile blocks — the same dispatcher contract
+    as ``run_window``, so the Pallas walk and the analytic span cannot
+    drift (ONE walk body serves both)."""
+    if mode == "off":
+        return fast_forward_walk(params, vp, fi)
+    return dispatch.run_fused(
+        lambda fi2, vp2: fast_forward_walk(params, vp2, fi2),
+        fi, vp, FF_IN_AXES, FFOut, FF_OUT_AXES,
+        params.num_tiles, mode, "fast_forward_walk")
+
+
+def shard_local_ff_in(fi: FFIn, shard_idx, tiles_local: int) -> FFIn:
+    """Slice every fast-forward operand to one shard's tiles along its
+    declared axis (``FF_IN_AXES``; None-axis leaves replicate) — the
+    ``shard_local_window_in`` rule on the FF operand set."""
+
+    def slc(name, leaf):
+        ax = FF_IN_AXES[name]
+        if ax is None:
+            return leaf
+        return jax.lax.dynamic_slice_in_dim(
+            leaf, shard_idx * tiles_local, tiles_local, axis=ax)
+
+    return FFIn(**{f: slc(f, v) for f, v in zip(FFIn._fields, fi)})
+
+
+def run_fast_forward_sharded(params: SimParams, vp: VariantParams,
+                             fi: FFIn, mode: str) -> FFOut:
+    """The fast-forward walk under ``tpu/tile_shards`` > 1: slice to the
+    shard's T/S tiles, run the UNCHANGED walk, tiled-all_gather the
+    outputs — bit-identical to the unsharded leg by the same
+    construction as ``run_window_sharded`` (per-tile independent,
+    shape-polymorphic, exact block reconstruction)."""
+    from graphite_tpu.parallel.mesh import TILE_AXIS
+
+    shards = params.tile_shards
+    TL = params.num_tiles // shards
+    fi_l = shard_local_ff_in(fi, jax.lax.axis_index(TILE_AXIS), TL)
+    if mode == "off":
+        out_l = fast_forward_walk(params, vp, fi_l)
+    else:
+        out_l = dispatch.run_fused(
+            lambda fi2, vp2: fast_forward_walk(params, vp2, fi2),
+            fi_l, vp, FF_IN_AXES, FFOut, FF_OUT_AXES,
+            TL, mode, "fast_forward_walk")
+
+    def gather(name, leaf):
+        return jax.lax.all_gather(leaf, TILE_AXIS,
+                                  axis=FF_OUT_AXES[name], tiled=True)
+
+    return FFOut(**{f: gather(f, v)
+                    for f, v in zip(FFOut._fields, out_l)})

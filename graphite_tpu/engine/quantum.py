@@ -366,9 +366,20 @@ def quantum_step(params: SimParams, state: SimState,
         # fuses with the round's epilogue.
         return i + 1, cur, progress(st), st
 
+    ff0 = state.ctr_ff if params.fast_forward > 0 else None
     _, _, _, state = jax.lax.while_loop(
         cond, body,
         (jnp.int32(0), jnp.int64(-1), progress(state), state))
+    if params.fast_forward > 0:
+        # Fast-forwarded-quanta attribution (round 12): a quantum counts
+        # once iff some sub-round committed an analytic span — the
+        # bench's ff-quanta fraction is ctr_ffq / ctr_quantum.  No
+        # boundary patch is needed here: committed spans advance
+        # st.clock DIRECTLY (unlike chain serves, which park progress in
+        # chain_base), so next_boundary's min-clock already leaps past
+        # fast-forwarded progress.
+        state = state._replace(
+            ctr_ffq=state.ctr_ffq + (state.ctr_ff > ff0).astype(jnp.int64))
     if sampling_enabled(params):
         state = _maybe_sample(params, state)
     return state

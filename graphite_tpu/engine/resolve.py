@@ -2244,8 +2244,11 @@ def resolve(params: SimParams, state: SimState,
         any_mem = ((state.pend_kind == PEND_SH_REQ)
                    | (state.pend_kind == PEND_EX_REQ)
                    | (state.pend_kind == PEND_IFETCH)).any()
-    state = jax.lax.cond(
-        any_mem, lambda s: resolve_memory(params, vp, s), lambda s: s, state)
+
+    def mem_pass(s: SimState) -> SimState:
+        return jax.lax.cond(
+            any_mem, lambda x: resolve_memory(params, vp, x),
+            lambda x: x, s)
 
     def sync_pass(s: SimState) -> SimState:
         if s.has_capi:
@@ -2267,5 +2270,21 @@ def resolve(params: SimParams, state: SimState,
         s = _when_pending(PEND_START, resolve_start, params, vp, s)
         return s
 
+    # ``any_sync`` may be read BEFORE the memory pass: resolve_memory
+    # clears memory parks and serves chains but never creates or clears
+    # a sync-kind park (all >= PEND_RECV), so the mask is identical on
+    # either side of it.
     any_sync = (state.pend_kind >= PEND_RECV).any()   # every non-memory kind
+    if params.fast_forward > 0:
+        # Round-12 skip-when-empty guard: fast-forwarded sub-rounds
+        # retire hit/compute spans that park NOTHING, so whole resolve
+        # calls go empty on miss-free stretches — fold both passes under
+        # one outer cond (inner conds preserved, result-identical) and
+        # skip the state pass-through entirely.
+        def both(s: SimState) -> SimState:
+            return jax.lax.cond(any_sync, sync_pass, lambda x: x,
+                                mem_pass(s))
+
+        return jax.lax.cond(any_mem | any_sync, both, lambda s: s, state)
+    state = mem_pass(state)
     return jax.lax.cond(any_sync, sync_pass, lambda s: s, state)

@@ -59,6 +59,12 @@ class SimSummary:
             f: np.asarray(getattr(state.counters, f))
             for f in state.counters._fields
         }
+        # Round-12 adaptive-fidelity attribution: engaged analytic
+        # rounds, quanta with >= 1 fast-forwarded span, events priced
+        # in closed form (all zero when tpu/fast_forward = 0).
+        self.ff_rounds = int(state.ctr_ff)
+        self.ff_quanta = int(state.ctr_ffq)
+        self.ff_events = int(state.ff_events)
         self.vm_brk = int(state.vm_brk)
         self.vm_mmap_bytes = int(state.vm_mmap_bytes)
         self.vm_munmap_bytes = int(state.vm_munmap_bytes)
@@ -221,6 +227,12 @@ class SimSummary:
             "num_streams": int(self.done.shape[0]),
             "aggregate": agg,
         }
+        if self.params.fast_forward > 0:
+            out["ff_rounds"] = self.ff_rounds
+            out["ff_quanta"] = self.ff_quanta
+            out["ff_events"] = self.ff_events
+            out["ff_quanta_frac"] = round(
+                self.ff_quanta / max(self.quanta, 1), 4)
         if self.params.enable_power_modeling:
             out["energy"] = self.energy().to_dict()
         vm_sec = self.vm_summary()
@@ -253,6 +265,12 @@ class SimSummary:
         row("Total Instructions", agg["icount"])
         row("Host Time (in s)", f"{self.host_seconds:.3f}")
         row("Simulated MIPS", f"{self.simulated_mips:.3f}")
+        if self.params.fast_forward > 0:
+            lines.append("[fast_forward]")
+            row("Analytic Rounds", self.ff_rounds)
+            row("Fast-Forwarded Quanta",
+                f"{self.ff_quanta} / {self.quanta}")
+            row("Events Priced In Closed Form", self.ff_events)
         lines.append("[core]")
         row("Total Instructions", agg["icount"])
         row("Branches", agg["branches"])

@@ -449,6 +449,14 @@ class SimState(NamedTuple):
     ctr_conflict: jnp.ndarray  # [] int64
     ctr_resolve: jnp.ndarray   # [] int64
     ctr_quantum: jnp.ndarray   # [] int64
+    # Round-12 fast-forward attribution: engaged fast-forward rounds
+    # (spans actually committed), quanta that committed at least one
+    # span, and total events priced analytically — the bench's
+    # ff-quanta-fraction numerator/denominator ride on ctr_ffq vs
+    # ctr_quantum.
+    ctr_ff: jnp.ndarray        # [] int64
+    ctr_ffq: jnp.ndarray       # [] int64
+    ff_events: jnp.ndarray     # [] int64
 
     # -- VMManager accounting (reference: vm_manager.cc bump segments).
     # SYSCALL events carry the payload in the event's addr field
@@ -716,6 +724,9 @@ def make_state(params: SimParams,
         ctr_conflict=jnp.int64(0),
         ctr_resolve=jnp.int64(0),
         ctr_quantum=jnp.int64(0),
+        ctr_ff=jnp.int64(0),
+        ctr_ffq=jnp.int64(0),
+        ff_events=jnp.int64(0),
         vm_brk=jnp.int64(0),
         vm_mmap_bytes=jnp.int64(0),
         vm_munmap_bytes=jnp.int64(0),

@@ -75,6 +75,11 @@ class VariantParams(NamedTuple):
     # Quantum cadence (ps).
     quantum_ps: jnp.ndarray               # int64
     thread_switch_quantum_ps: jnp.ndarray  # int64
+    # Round-12 fast-forward run-ahead budget (ps past the quantum
+    # boundary the analytic leg may commit; 0 = exact barrier).  Only
+    # read when the STRUCTURAL tpu/fast_forward mode compiled the leg
+    # in, so sweeping it never recompiles.
+    fast_forward_span_ps: jnp.ndarray     # int64
     # Core.
     bp_mispredict_penalty: jnp.ndarray    # int32 cycles
     dvfs_sync_delay_cycles: jnp.ndarray   # int32 cycles
@@ -110,6 +115,7 @@ def variant_params(params: SimParams) -> VariantParams:
     return VariantParams(
         quantum_ps=i64(params.quantum_ps),
         thread_switch_quantum_ps=i64(params.thread_switch_quantum_ps),
+        fast_forward_span_ps=i64(params.fast_forward_span_ps),
         bp_mispredict_penalty=i32(params.core.bp_mispredict_penalty),
         dvfs_sync_delay_cycles=i32(params.dvfs_sync_delay_cycles),
         syscall_cost_cycles=jnp.asarray(params.syscall_cost_cycles,

@@ -86,6 +86,23 @@ def _miss_chain(value: int) -> int:
     return value
 
 
+# Upper bound on tpu/fast_forward (span width, in block_events-sized
+# windows): an engaged fast-forward round prices its whole span under ONE
+# round_ctr value, so the span's per-event stamp offsets must fit the
+# round's exclusive STAMP_STRIDE allocation (the effective span is also
+# clipped to the resident window-cache width, 4 windows — see
+# engine/core._ff_width).  Values past STRIDE buy nothing.
+FAST_FORWARD_MAX = STAMP_STRIDE
+
+
+def _fast_forward(value: int) -> int:
+    if not 0 <= value <= FAST_FORWARD_MAX:
+        raise ConfigError(
+            f"tpu/fast_forward must be in [0, {FAST_FORWARD_MAX}]: "
+            f"{value}")
+    return value
+
+
 _PALLAS_KERNEL_MODES = ("auto", "off", "interpret", "on")
 
 
@@ -801,6 +818,27 @@ class SimParams:
     # outstanding token at max(park, token time) instead of enforcing
     # strict lost-signal eligibility (engine/resolve.resolve_cond).
     cond_replay: bool
+    # Round-12 adaptive-fidelity fast-forward (engine/core.py + the
+    # kernels/window.fast_forward_walk leg): before each detailed
+    # sub-round, detect tiles whose next events are ALL hit/compute —
+    # no bankable misses, no sync ops, no pending chain heads — and
+    # price the longest such prefix of the resident window in closed
+    # form (cumulative clock advance + bulk counter accumulation +
+    # batched LRU touches) instead of iterating window rounds.  The
+    # value is the span width in block_events-sized windows (clipped to
+    # the resident cache's 4 windows and the stamp stride); 0 compiles
+    # the fast-forward leg out entirely — bit-identical to the
+    # pre-round-12 engine (tests/data/fast_forward_golden.json).
+    fast_forward: int
+    # Fast-forward accuracy budget, picoseconds (config key
+    # tpu/fast_forward_span is in NANOSECONDS): eligible tiles may
+    # commit analytic progress up to this far PAST the quantum boundary,
+    # trading barrier fidelity for fewer quanta, the same knob class as
+    # Graphite's lax synchronization.  0 (the default) keeps the exact
+    # quantum barrier.  VARIANT in the sweep zoo — a traced operand
+    # (vparams.py), so sweeps get a cost/accuracy axis without
+    # recompiling.
+    fast_forward_span_ps: int
 
     @property
     def line_size(self) -> int:
@@ -1069,4 +1107,9 @@ class SimParams:
                 cfg.get_str("tpu/tile_shards", "1"), T),
             channel_depth=cfg.get_int("tpu/channel_depth", 16),
             cond_replay=cfg.get_bool("tpu/cond_replay", False),
+            fast_forward=_fast_forward(
+                cfg.get_int("tpu/fast_forward", 0)),
+            fast_forward_span_ps=int(ns_to_ps(_nonneg(
+                cfg.get_int("tpu/fast_forward_span", 0),
+                "tpu/fast_forward_span"))),
         )

@@ -50,6 +50,8 @@ from graphite_tpu.params import SimParams
 VARIANT_LEAVES = frozenset({
     # quantum cadence + DVFS points
     "quantum_ps", "thread_switch_quantum_ps", "max_frequency_ghz",
+    # fast-forward accuracy budget (run-ahead ps; the MODE is structural)
+    "fast_forward_span_ps",
     "dvfs_domains", "dvfs_sync_delay_cycles",
     # syscall service table
     "syscall_cost_cycles",
@@ -102,6 +104,7 @@ STRUCTURAL_LEAVES = frozenset({
     "rounds_per_quantum", "quanta_per_step", "max_inv_fanout_per_round",
     "miss_chain", "max_resolve_rounds", "channel_depth",
     "tile_shards",                # selects the sharded vs solo program
+    "fast_forward",               # compiles the analytic leg in or out
 } | {f"{c}.{f}" for c in ("l1i", "l1d", "l2") for f in _CACHE_STRUCT}
   | {f"{n}.atac.{f}" for n in ("net_user", "net_memory")
      for f in _ATAC_STRUCT})
@@ -209,6 +212,7 @@ def canonical_params(params: SimParams) -> SimParams:
         params,
         quantum_ps=1_000_000,
         thread_switch_quantum_ps=10_000_000,
+        fast_forward_span_ps=0,
         max_frequency_ghz=1.0,
         dvfs_domains=((1.0, ()),),
         dvfs_sync_delay_cycles=1,

@@ -23,3 +23,17 @@ _CACHE_DIR = os.path.join(os.path.dirname(__file__), os.pardir,
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE_DIR))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Single-process full-suite runs accumulate jit/tracing cache state
+    until dispatch slows to a crawl (the reason tools/run_tests.sh runs
+    one process per module). Dropping the in-memory caches at module
+    boundaries keeps the full-suite run at per-module pace; the
+    persistent compile cache above turns any re-lowering into a fast
+    deserialize."""
+    yield
+    jax.clear_caches()

@@ -1,0 +1,280 @@
+"""Adaptive-fidelity fast-forward (round 12): analytic miss-free spans.
+
+The contract under test:
+
+  * **Exactness at 0** — ``tpu/fast_forward = 0`` is today's exact
+    program: per-tile clocks, every counter, and every phase-execution
+    counter BIT-IDENTICAL to the pre-round-12 engine, pinned as a
+    committed fixture (tests/data/fast_forward_golden.json, captured
+    from the round-11 HEAD; the engine is deterministic, so any drift
+    is a real semantic change, not noise).
+  * **Bounded drift on** — pricing hit/compute spans in closed form may
+    shift time only within the accuracy budget (REL_TOL, the same 2%
+    the chain replay is held to), conserving every retired event.
+  * **Round win** — on a hit-heavy trace the analytic leg must engage
+    (ctr_ff > 0) and strictly cut the engine round count.
+  * **Composition** — checkpoints cut mid-fast-forward resume
+    bit-identically; a tile-sharded ff run matches the unsharded one;
+    ``fast_forward_span`` sweeps as a VARIANT operand (lanes equal
+    solo runs) while ``fast_forward`` itself is STRUCTURAL.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigError, load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+pytestmark = pytest.mark.quick
+
+REL_TOL = 0.02
+
+ROUND_CTRS = ("ctr_quantum", "ctr_window", "ctr_complex", "ctr_conflict",
+              "ctr_resolve", "round_ctr", "ctr_ff", "ctr_ffq")
+
+
+def _run(trace, num_tiles, fast_forward, max_steps=256, **over):
+    cfg = load_config()
+    cfg.set("general/total_cores", num_tiles)
+    cfg.set("tpu/fast_forward", fast_forward)
+    for k, v in over.items():
+        cfg.set(k, v)
+    params = SimParams.from_config(cfg)
+    sim = Simulator(params, trace)
+    return sim, sim.run(max_steps=max_steps)
+
+
+def _assert_drift_bounded(base, fast, tol=REL_TOL):
+    assert base.done.all() and fast.done.all()
+    rel = abs(fast.completion_time_ps - base.completion_time_ps) \
+        / max(base.completion_time_ps, 1)
+    assert rel <= tol, (
+        f"fast-forward completion {fast.completion_time_ps} vs exact "
+        f"{base.completion_time_ps} ({rel:.1%} > {tol:.0%})")
+    # Event conservation: the analytic leg prices events, it must not
+    # invent or drop any.
+    for k in ("icount", "l1d_read", "l1d_write", "branches"):
+        np.testing.assert_array_equal(base.counters[k], fast.counters[k],
+                                      k)
+
+
+def test_ff_off_bit_identical_to_golden():
+    """fast_forward = 0 identity oracle: the analytic leg is compiled in
+    ONLY when tpu/fast_forward > 0, so the default engine must stay
+    bit-identical to the fixture captured from the pre-round-12 HEAD —
+    per-tile clocks, every counter, every phase-execution counter."""
+    gold = json.load(open(os.path.join(
+        os.path.dirname(__file__), "data", "fast_forward_golden.json")))
+    cases = {
+        "radix8": synth.gen_radix(num_tiles=8, keys_per_tile=64,
+                                  radix=16, seed=3),
+        "fft8": synth.gen_fft(num_tiles=8, points_per_tile=64),
+    }
+    for name, trace in cases.items():
+        g = gold[name]
+        sim, s = _run(trace, 8, 0)
+        assert s.done.all()
+        assert s.completion_time_ps == g["completion_time_ps"], name
+        assert np.asarray(s.clock).tolist() == g["clock"], name
+        for f, want in g["round_ctrs"].items():
+            got = int(getattr(sim.state, f))
+            assert got == want, f"{name}.{f}: {got} != golden {want}"
+        for k, want in g["counters"].items():
+            assert np.asarray(s.counters[k]).tolist() == want, \
+                f"{name}.{k}"
+
+
+def test_radix_ff_drift_bounded():
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=64, radix=16,
+                            seed=3)
+    _, base = _run(trace, 8, 0)
+    sim, fast = _run(trace, 8, 4)
+    _assert_drift_bounded(base, fast)
+    # The hit-heavy radix trace must actually exercise the leg — a
+    # drift gate over a never-engaging leg proves nothing.
+    assert int(sim.state.ctr_ff) > 0
+    assert int(sim.state.ctr_ffq) > 0
+    assert int(sim.state.ff_events) > 0
+
+
+def test_fft_ff_drift_bounded():
+    trace = synth.gen_fft(num_tiles=8, points_per_tile=64)
+    _, base = _run(trace, 8, 0)
+    _, fast = _run(trace, 8, 4)
+    _assert_drift_bounded(base, fast)
+
+
+@pytest.mark.slow
+def test_radix_ff_drift_bounded_t64():
+    """The CI drift gate's large shape: the span pricing must hold the
+    budget when 64 tiles contend for the directory."""
+    trace = synth.gen_radix(num_tiles=64, keys_per_tile=64, radix=64,
+                            seed=3)
+    _, base = _run(trace, 64, 0)
+    _, fast = _run(trace, 64, 8)
+    _assert_drift_bounded(base, fast)
+
+
+def test_migratory_ff_pinned():
+    """Known-limit canary (mirrors the chain replay's migratory pin):
+    the pure migratory probe is all misses, so the analytic leg should
+    rarely engage — but whatever it does must stay inside the same 12%
+    out-of-class bound the chain engine is held to."""
+    trace = synth.gen_migratory(8, lines=16, rounds=8)
+    _, base = _run(trace, 8, 0, max_steps=512)
+    _, fast = _run(trace, 8, 4, max_steps=512)
+    assert base.done.all() and fast.done.all()
+    rel = abs(fast.completion_time_ps - base.completion_time_ps) \
+        / max(base.completion_time_ps, 1)
+    assert rel <= 0.12, (
+        f"migratory fast-forward drift {rel:.1%} > 12% known-limit "
+        f"bound")
+
+
+def test_ff_rounds_drop():
+    """The tentpole's point: pricing miss-free spans in closed form must
+    cut engine rounds on a hit-heavy trace — each engaged analytic
+    round retires more than one window round's worth of events."""
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=64, radix=16,
+                            seed=3)
+    sim_off, base = _run(trace, 8, 0)
+    sim_on, fast = _run(trace, 8, 4)
+    assert base.done.all() and fast.done.all()
+    off = int(sim_off.state.round_ctr)
+    on = int(sim_on.state.round_ctr)
+    assert int(sim_on.state.ctr_ff) > 0
+    assert on < off, f"rounds {on} !< {off} with fast_forward on"
+
+
+def test_ff_checkpoint_resume_identical(tmp_path):
+    """A checkpoint cut mid-run with the analytic leg engaged resumes
+    bit-identically: the attribution scalars (ctr_ff/ctr_ffq/ff_events)
+    ride the schema, and the resumed run's rounds, clocks, and counters
+    equal the uninterrupted run's."""
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=64, radix=16,
+                            seed=3)
+    sets = {"tpu/fast_forward": 4}
+
+    full_sim, s_full = _run(trace, 8, 4)
+
+    cfg = load_config()
+    cfg.set("general/total_cores", 8)
+    for k, v in sets.items():
+        cfg.set(k, v)
+    params = SimParams.from_config(cfg)
+    half = Simulator(params, trace)
+    half.run(max_steps=2)
+    ck = str(tmp_path / "ck_ff.npz")
+    half.save_checkpoint(ck)
+
+    resumed = Simulator(params, trace)
+    resumed.restore_checkpoint(ck)
+    s_res = resumed.run(max_steps=256)
+
+    assert s_full.done.all() and s_res.done.all()
+    assert s_res.completion_time_ps == s_full.completion_time_ps
+    np.testing.assert_array_equal(s_res.clock, s_full.clock)
+    for f in ROUND_CTRS:
+        assert int(getattr(resumed.state, f)) \
+            == int(getattr(full_sim.state, f)), f
+    assert int(resumed.state.ff_events) == int(full_sim.state.ff_events)
+    for k in s_full.counters:
+        np.testing.assert_array_equal(s_res.counters[k],
+                                      s_full.counters[k], k)
+
+
+def test_ff_sharded_bit_identical():
+    """tile_shards > 1 with the analytic leg on: the per-shard span walk
+    (slice -> walk -> all_gather, like the window walk) must reproduce
+    the unsharded run exactly — every state leaf including the ff
+    attribution scalars."""
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=64, radix=16,
+                            seed=3)
+
+    def params_for(shards):
+        cfg = load_config()
+        cfg.set("general/total_cores", 8)
+        cfg.set("tpu/tile_shards", str(shards))
+        cfg.set("tpu/fast_forward", 4)
+        return SimParams.from_config(cfg)
+
+    sharded = Simulator(params_for(8), trace)
+    sharded.run()
+    solo = Simulator(params_for(1), trace)
+    solo.run()
+    assert int(solo.state.ctr_ff) > 0   # the leg engaged
+    for name in solo.state._fields:
+        x, y = getattr(solo.state, name), getattr(sharded.state, name)
+        if hasattr(x, "_fields"):
+            for f in x._fields:
+                u, v = getattr(x, f), getattr(y, f)
+                if u is None:
+                    assert v is None, f"{name}.{f}"
+                    continue
+                assert np.array_equal(np.asarray(u), np.asarray(v)), \
+                    f"{name}.{f}"
+            continue
+        if x is None:
+            assert y is None, name
+            continue
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+
+# ------------------------------------------------------- sweep surface
+
+def test_ff_leaves_classified():
+    """fast_forward compiles the analytic leg in or out (STRUCTURAL);
+    the span budget is a traced operand (VARIANT), so sweeping it never
+    recompiles."""
+    from graphite_tpu.sweep.space import (STRUCTURAL_LEAVES,
+                                          VARIANT_LEAVES, classify)
+    assert classify("fast_forward", 0) == "structural"
+    assert "fast_forward" in STRUCTURAL_LEAVES
+    assert classify("fast_forward_span_ps", 0) == "variant"
+    assert "fast_forward_span_ps" in VARIANT_LEAVES
+
+
+def test_sweep_ff_span_axis_bit_identical():
+    """One sweep axis over tpu/fast_forward_span at fast_forward = 4:
+    every lane bit-identical to its solo run — the span budget enters
+    as a VARIANT operand either way, vmap only adds the batch axis."""
+    from graphite_tpu.sweep import SweepDriver, build_variants
+    cfg = load_config()
+    cfg.set("general/total_cores", 8)
+    cfg.set("tpu/fast_forward", 4)
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=64, radix=16,
+                            seed=3)
+    variants = build_variants(
+        cfg, ["tpu/fast_forward_span=0,50,200,1000"])
+    assert len(variants) == 4
+
+    drv = SweepDriver(trace)
+    tickets = [drv.submit(p) for _, _, p in variants]
+    results = drv.drain()
+
+    for (label, _, p), t in zip(variants, tickets):
+        lane = results[t]
+        solo = Simulator(p, trace).run()
+        np.testing.assert_array_equal(np.asarray(lane.clock),
+                                      np.asarray(solo.clock), label)
+        assert lane.done.all() and solo.done.all(), label
+        for k in lane.counters:
+            np.testing.assert_array_equal(lane.counters[k],
+                                          solo.counters[k],
+                                          f"{label}.{k}")
+
+
+def test_ff_config_validation():
+    cfg = load_config()
+    cfg.set("tpu/fast_forward", 65)
+    with pytest.raises(ConfigError):
+        SimParams.from_config(cfg)
+    cfg.set("tpu/fast_forward", -1)
+    with pytest.raises(ConfigError):
+        SimParams.from_config(cfg)

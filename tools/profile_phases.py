@@ -59,6 +59,17 @@ def main():
         t_rs = bench_fn(rs, state2)
         t_ms = bench_fn(ms, state)
 
+        t_ff = None
+        if params.fast_forward > 0:
+            # Round-12 analytic leg alone (probe + engaged span), e.g.
+            #   python tools/profile_phases.py 64 --set tpu/fast_forward=4
+            from graphite_tpu.engine.core import _fast_forward_guarded
+            from graphite_tpu.engine.vparams import variant_params
+            vp = variant_params(params)
+            ff = jax.jit(
+                lambda s: _fast_forward_guarded(params, vp, s, ta))
+            t_ff = bench_fn(ff, state2)
+
         # events retired in the first local_advance
         ev = int(jax.device_get(state2.cursor.sum()))
         row = {
@@ -68,6 +79,8 @@ def main():
             "megastep_s": round(t_ms, 5),
             "events_first_la": ev,
         }
+        if t_ff is not None:
+            row["fast_forward_s"] = round(t_ff, 5)
         if overrides:
             row["overrides"] = overrides
         print(json.dumps(row), flush=True)

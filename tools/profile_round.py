@@ -81,6 +81,20 @@ def main():
     if params.block_events > 0:
         phases.insert(0, ("block",
                           lambda s, t: _block_retire(params, vp, s, t)))
+    if params.fast_forward > 0:
+        # Round-12 legs, e.g.:
+        #   python tools/profile_round.py 64 20 --set tpu/fast_forward=4
+        # block_wide is the wide fast-forward window round the cadence
+        # actually runs; fast_forward is the analytic run-ahead probe.
+        from graphite_tpu.engine.core import (_fast_forward_guarded, _ff_width)
+        W = _ff_width(params)
+        if W > params.block_events:
+            phases.insert(0, ("block_wide",
+                              lambda s, t: _block_retire(
+                                  params, vp, s, t, width=W)))
+        phases.insert(0, ("fast_forward",
+                          lambda s, t: _fast_forward_guarded(
+                              params, vp, s, t)))
     for name, fn in phases:
         us = fused(fn, state, ta, iters)
         print(f"T={T} {name}: {us:.0f} us/round", flush=True)

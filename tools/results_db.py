@@ -68,6 +68,12 @@ CREATE INDEX IF NOT EXISTS runs_workload ON runs (workload, ts);
 
 REGRESSION_PCT = 20.0
 
+# Round-12 accuracy budget: a bench ``*_ff`` row's completion-time
+# drift vs the exact (fast_forward = 0) program.  Matches the hard CI
+# ceiling in tools/run_tests.sh and tests/test_fast_forward.py — an
+# ingested row above it flags unconditionally (no history needed).
+FF_DRIFT_BUDGET = 0.02
+
 
 def open_db(path: str) -> sqlite3.Connection:
     db = sqlite3.connect(path)
@@ -151,6 +157,28 @@ def quanta_per_sec(row: dict):
     return q if q > 0 else None
 
 
+def ff_quanta_frac(row: dict):
+    """Adaptive-fidelity occupancy (round 12): fraction of quanta that
+    fast-forwarded at least one analytic span (bench ``*_ff`` rows
+    carry it directly; otherwise it derives from ``ff_quanta`` over
+    ``quanta``).  A drop means miss-free spans stopped engaging the
+    closed-form leg — the round-count win silently eroding even when
+    CPU wall-clock stays flat.  None for rows recorded with
+    fast_forward off."""
+    f = row.get("ff_quanta_frac")
+    if f is None:
+        ffq = row.get("ff_quanta")
+        quanta = row.get("quanta")
+        if ffq is None or not quanta:
+            return None
+        f = float(ffq) / float(quanta)
+    try:
+        f = float(f)
+    except (TypeError, ValueError):
+        return None
+    return f if f > 0 else None
+
+
 def _count_metric(key):
     """Lower-is-better structural count (e.g. ``lowered_window_calls``:
     pallas_call sites in the lowered window round — 1 when the phase is
@@ -197,7 +225,12 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
     metrics = (("rounds/s", rounds_per_sec), ("MIPS", _mips),
                ("variants/s", variants_per_sec),
                ("events/round", events_per_round),
-               ("quanta/s", quanta_per_sec))
+               ("quanta/s", quanta_per_sec),
+               # Round 12: the fast-forwarded-quanta fraction chains
+               # like events/round — a >threshold drop vs the most
+               # recent prior comparable row flags even though host
+               # timing on a CPU container never would.
+               ("ff-quanta-frac", ff_quanta_frac))
     warnings = []
     for name, fn in metrics:
         new = fn(row)
@@ -239,6 +272,19 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
             warnings.append(
                 f"REGRESSION {workload}: {name} rose {old:.0f} -> "
                 f"{new:.0f} (structural op count must not grow)")
+    # Round-12 accuracy gate: fast-forward drift is an ABSOLUTE budget,
+    # not a chained comparison — the analytic leg's completion-time
+    # error vs the exact program must stay inside FF_DRIFT_BUDGET on
+    # every ingest, regardless of what prior rows recorded.
+    try:
+        drift = float(row.get("ff_drift"))
+    except (TypeError, ValueError):
+        drift = None
+    if drift is not None and drift > FF_DRIFT_BUDGET:
+        warnings.append(
+            f"DRIFT {workload}: fast-forward completion-time drift "
+            f"{drift:.4f} exceeds accuracy budget "
+            f"{FF_DRIFT_BUDGET:.2f}")
     return "\n".join(warnings) if warnings else None
 
 

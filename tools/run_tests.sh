@@ -392,6 +392,64 @@ if [ $shard_rc -ne 0 ]; then
     fail=1
 fi
 
+# Fast-forward smoke gate (ISSUE 14 CI satellite): the adaptive-fidelity
+# analytic leg on the tiny radix-8 trace must (1) leave fast_forward=0
+# EXACTLY on the committed golden fixture (the leg is compiled in only
+# when the knob is > 0 — the default engine cannot drift), (2) engage
+# and strictly CUT the engine round count with the leg on (rounds are
+# exact and deterministic, so a strict drop is a hard floor, not a
+# noisy ratio), and (3) hold the completion-time drift under the 2%
+# accuracy budget — the same ceiling the bench *_ff rows and
+# results_db's DRIFT flag enforce.
+ff_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import json, os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+DRIFT_CEILING = 0.02
+
+def run(ff):
+    cfg = load_config()
+    cfg.set("general/total_cores", 8)
+    cfg.set("tpu/fast_forward", ff)
+    params = SimParams.from_config(cfg)
+    sim = Simulator(params, trace)
+    s = sim.run(max_steps=256)
+    assert s.done.all(), f"ff={ff} smoke trace did not complete"
+    return sim, s
+
+# Same shape as the golden fixture -> persistent-cache hit.
+trace = synth.gen_radix(num_tiles=8, keys_per_tile=64, radix=16, seed=3)
+gold = json.load(open("tests/data/fast_forward_golden.json"))["radix8"]
+sim0, s0 = run(0)
+assert s0.completion_time_ps == gold["completion_time_ps"], \
+    "fast_forward=0 completion drifted off the golden fixture"
+assert int(sim0.state.round_ctr) == gold["round_ctrs"]["round_ctr"], \
+    "fast_forward=0 round count drifted off the golden fixture"
+sim4, s4 = run(4)
+r0 = int(jax.device_get(sim0.state.round_ctr))
+r4 = int(jax.device_get(sim4.state.round_ctr))
+assert int(sim4.state.ctr_ff) > 0, "analytic leg never engaged"
+assert r4 < r0, f"ROUND DROP FLOOR: ff rounds {r4} !< exact {r0}"
+drift = abs(s4.completion_time_ps - s0.completion_time_ps) \
+    / max(s0.completion_time_ps, 1)
+assert drift <= DRIFT_CEILING, (
+    f"DRIFT CEILING: {drift:.2%} > {DRIFT_CEILING:.0%}")
+print(f"FAST-FORWARD SMOKE OK (rounds {r0} -> {r4}, "
+      f"{int(sim4.state.ctr_ff)} analytic rounds, drift {drift:.2%})")
+PYEOF
+)
+ff_rc=$?
+echo "$ff_out" | tail -3
+if [ $ff_rc -ne 0 ]; then
+    echo "FAST-FORWARD SMOKE GATE FAILED"
+    fail=1
+fi
+
 if [ $fail -eq 0 ]; then
     echo "ALL MODULES PASSED"
 else
