@@ -391,6 +391,76 @@ def _sweep_row():
     }
 
 
+def _service_row():
+    """Fault-tolerant service layer over the sweep engine (ISSUE 15):
+    V=4 design points served through SweepService (journal + results_db)
+    and then RE-SERVED from cache by a second service instance —
+    cache_hits must equal V with zero buckets run, which is the
+    serve-from-cache acceptance shape as a bench row."""
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    from graphite_tpu.config import load_config
+    from graphite_tpu.events import synth
+    from graphite_tpu.sweep import SweepDriver, SweepService, build_variants
+
+    V = 4
+    T = 8
+    cfg = load_config()
+    cfg.set("general/total_cores", T)
+    trace = synth.gen_radix(T, keys_per_tile=64, radix=16, seed=9)
+    spec = ["dram/latency=" + ",".join(
+        str(60 + 20 * i) for i in range(V))]
+    variants = build_variants(cfg, spec)
+    points = [overrides for _, overrides, _ in variants]
+
+    tmp = tempfile.mkdtemp(prefix="svc_bench_")
+    try:
+        # Warm the V=4 bucket program so host_seconds is serving time,
+        # not compile time (same policy as every other row).
+        warm = SweepDriver(trace, max_steps=2)
+        for _, _, p in variants:
+            warm.submit(p)
+        warm.drain()
+
+        db = os.path.join(tmp, "results.db")
+        svc = SweepService(trace, os.path.join(tmp, "j1"), cfg=cfg,
+                           db_path=db)
+        tids = [svc.submit(ov) for ov in points]
+        t0 = time.perf_counter()
+        res = svc.serve()
+        host_s = time.perf_counter() - t0
+        all_done = all(res[t].status == "done" for t in tids)
+
+        svc2 = SweepService(trace, os.path.join(tmp, "j2"), cfg=cfg,
+                            db_path=db)
+        for ov in points:
+            svc2.submit(ov)
+        t1 = time.perf_counter()
+        svc2.serve()
+        cache_s = time.perf_counter() - t1
+        return {
+            "kind": "completed" if all_done else "throughput_probe",
+            "num_tiles": T,
+            "variants": V,
+            "host_seconds": round(host_s, 3),
+            "variants_per_sec": round(V / max(host_s, 1e-9), 3),
+            "compiles": svc.compiles_observed,
+            "cache_hits": svc2.stats["cache_hits"],
+            "cache_serve_seconds": round(cache_s, 3),
+            "served_from_cache": bool(
+                svc2.stats["cache_hits"] == V
+                and svc2.stats["buckets_run"] == 0),
+            "all_done": all_done,
+            "workload": "radix8 x 4 variants via fault-tolerant service "
+                        "+ results_db cache re-serve",
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # Captured SPLASH-2 workloads (reference: tests/benchmarks/Makefile:4-8):
 # UNMODIFIED vendored sources, macro-expanded (tools/splash_m4.py) +
 # TSan-instrumented (tools/capture_build.sh), run natively to produce a
@@ -718,6 +788,13 @@ def main(argv=None) -> int:
     # asserts the bit-identity contract on the batch's first and last
     # lanes against solo Simulator runs (clocks + every counter).
     safe("radix8_sweep8", _sweep_row)
+
+    # Service-layer row (ISSUE 15): the same sweep engine behind the
+    # crash-safe ticket service, plus the serve-from-cache re-serve —
+    # cache_hits == variants with zero buckets run is the cache tier
+    # working end to end (results_db keyed on structural + variant
+    # signatures + trace hash).
+    safe("radix8_service", _service_row)
 
     # BASELINE config 1 scaling: radix at 256 and 1024 tiles.  Every
     # point COMPLETES (valid MIPS) — the 1024 row runs a narrow block

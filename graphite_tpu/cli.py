@@ -41,16 +41,33 @@ def _build_parser() -> argparse.ArgumentParser:
     sw.add_argument("-c", "--config", default=None)
     sw.add_argument("--trace", required=True, help="trace .npz path")
     sw.add_argument("--sweep", action="append", default=[], metavar="SPEC",
-                    required=True,
                     help="sweep axis: section/key=v1,v2,... — repeat for "
                          "a cross product; join keys with ';' inside one "
                          "flag to zip them (sweep/space.py grammar). "
                          "Keys must be VARIANT leaves (timing numerics); "
-                         "structural keys are rejected.")
+                         "structural keys are rejected. Required unless "
+                         "--resume replays an existing journal.")
     sw.add_argument("-o", "--output", default=None,
                     help="write per-variant JSON rows here (shaped like a "
                          "bench result: {'detail': {label: row}}, so "
                          "tools/results_db.py add ingests it directly)")
+    sw.add_argument("--serve", action="store_true",
+                    help="run through the fault-tolerant SweepService "
+                         "(crash-safe ticket journal, bucket bisection, "
+                         "preempt/resume — sweep/service.py) instead of "
+                         "the bare driver; requires --journal")
+    sw.add_argument("--resume", action="store_true",
+                    help="recover an interrupted service run from "
+                         "--journal (re-queues in-flight tickets, "
+                         "resumes preempted buckets, never re-runs DONE "
+                         "ones); implies --serve, --sweep optional")
+    sw.add_argument("--journal", default=None, metavar="DIR",
+                    help="service journal directory (ticket records + "
+                         "preemption checkpoints)")
+    sw.add_argument("--db", default=None, metavar="PATH",
+                    help="results_db sqlite path: completed tickets are "
+                         "stored and identical re-submissions are served "
+                         "from cache without simulating")
 
     par = sub.add_parser("params", help="print derived simulation parameters")
     par.add_argument("-c", "--config", default=None)
@@ -103,6 +120,12 @@ def _sweep_command(cfg, args) -> int:
     from graphite_tpu.sweep import SweepDriver, build_variants
     from graphite_tpu.time_base import ps_to_ns
 
+    if args.serve or args.resume:
+        return _serve_command(cfg, args)
+    if not args.sweep:
+        print("sweep: --sweep is required (unless --serve/--resume)",
+              file=sys.stderr)
+        return 2
     trace = Trace.load(args.trace)
     variants = build_variants(cfg, args.sweep, num_tiles=trace.num_tiles)
     drv = SweepDriver(trace)
@@ -151,6 +174,69 @@ def _sweep_command(cfg, args) -> int:
             f.write(line + "\n")
     print(line)
     return 0
+
+
+def _serve_command(cfg, args) -> int:
+    """sweep --serve / --resume: the fault-tolerant service path.  New
+    --sweep points are submitted as tickets; with --resume the journal's
+    recovered tickets (re-queued in-flight work, preempted buckets) are
+    served too.  Output rows mirror the driver path's shape so
+    results_db ingestion and the recovery gate's bit-identity diff work
+    unchanged."""
+    import time
+
+    from graphite_tpu.events.schema import Trace
+    from graphite_tpu.sweep import SweepService, parse_sweep_spec
+
+    journal = args.journal or cfg.get_str("service/journal_dir", "")
+    if not journal:
+        print("sweep --serve/--resume needs --journal DIR",
+              file=sys.stderr)
+        return 2
+    if not args.sweep and not args.resume:
+        print("sweep --serve: nothing to do (no --sweep and no "
+              "--resume)", file=sys.stderr)
+        return 2
+    trace = Trace.load(args.trace)
+    svc = SweepService(trace, journal, cfg=cfg, db_path=args.db)
+    for overrides in parse_sweep_spec(args.sweep) if args.sweep else []:
+        svc.submit(overrides)
+    t0 = time.perf_counter()
+    tickets = svc.serve()
+    host_s = time.perf_counter() - t0
+    detail = {}
+    for t in sorted(tickets.values(), key=lambda t: t.ticket):
+        if t.status == "done":
+            row = dict(t.summary)
+            row["overrides"] = t.overrides
+            row["ticket"] = t.ticket
+            row["status"] = t.status
+            row["from_cache"] = t.from_cache
+        else:
+            row = {"kind": "service_ticket", "ticket": t.ticket,
+                   "overrides": t.overrides, "status": t.status,
+                   "error": t.error}
+        detail[t.label] = row
+        print(f"ticket {t.ticket} [{t.label}]: {t.status}"
+              f"{' (cache)' if t.from_cache else ''}"
+              f"{' — ' + t.error if t.error else ''}")
+    out = {
+        "metric": "sweep_service",
+        "workload": args.trace,
+        "tickets": len(tickets),
+        "host_seconds": round(host_s, 3),
+        "compiles": svc.compiles_observed,
+        "stats": svc.stats,
+        "detail": detail,
+    }
+    line = json.dumps(out)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    print(line)
+    quarantined = sum(1 for t in tickets.values()
+                      if t.status in ("quarantined", "failed"))
+    return 0 if quarantined == 0 else 3
 
 
 def _run_command(cfg, args, telemetry_dir: Optional[str]) -> int:

@@ -10,13 +10,20 @@ bit-identically (resume is deterministic — the engine has no RNG and no
 host-order dependence).
 
 Format: a single .npz whose keys are the flattened pytree paths, plus
-engine metadata (steps, schema version).  Orbax-style async/sharded
+engine metadata (steps, schema version; batched sweep checkpoints add the
+variant count).  Writes are ATOMIC — tmp file in the target directory,
+fsync, rename — so a crash mid-save leaves the previous checkpoint intact
+instead of a torn one; the sweep service's preempt/resume leans on this.
+Truncated/corrupt files surface as ``CheckpointCorruptError`` naming the
+path, never a raw zipfile traceback.  Orbax-style async/sharded
 checkpointing can layer on the same pytree for multi-host runs.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+import os
+import tempfile
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +32,10 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 24  # v24: round-12 adaptive-fidelity fast-forward —
+_SCHEMA_VERSION = 25  # v25: fault-tolerant sweep service — batched
+#   [V]-leading SweepSimulator checkpoints (save/load_sweep_checkpoint,
+#   __meta_variants) and atomic tmp+fsync+rename writes;
+#   v24: round-12 adaptive-fidelity fast-forward —
 #   the analytic-span attribution scalars (ctr_ff/ctr_ffq/ff_events)
 #   join the phase-counter block so a mid-fast-forward checkpoint
 #   resumes with exact round/quantum accounting;
@@ -61,6 +71,13 @@ _SCHEMA_VERSION = 24  # v24: round-12 adaptive-fidelity fast-forward —
 #   v8: cond vars + thread lifecycle (spawned_at/done_at, cond tokens)
 
 
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint file is unreadable — truncated mid-write by a
+    crash, or damaged on storage.  Saves are atomic (tmp+fsync+rename),
+    so a corrupt file is never the only copy a healthy writer left;
+    delete it and fall back to re-running from the last good state."""
+
+
 def _flatten_with_paths(state: SimState):
     leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
     out = {}
@@ -72,11 +89,99 @@ def _flatten_with_paths(state: SimState):
     return out, treedef
 
 
+def _atomic_savez(path: str, arrays: dict) -> None:
+    """Write the .npz atomically: tmp file beside the target, fsync,
+    rename — a crash at any point leaves either the old file or the new
+    one, never a torn write (same pattern as events/trace_cache.py)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+    pending = tmp
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        pending = None
+    finally:
+        if pending is not None:
+            try:
+                os.unlink(pending)
+            except OSError:
+                pass
+    from graphite_tpu.testing import faults
+    faults.maybe_truncate(path)
+
+
+def _open_checkpoint(path: str):
+    """np.load with corrupt-file classification: anything the zip/npz
+    layer throws (BadZipFile on a truncated archive, EOFError, pickle
+    noise) becomes a CheckpointCorruptError naming the path."""
+    try:
+        z = np.load(path)
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} is unreadable "
+            f"({type(e).__name__}: {e}) — truncated or corrupt; delete "
+            f"it and re-run from the last good state") from e
+    if "__meta_schema" not in z.files:
+        z.close()
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} has no __meta_schema field — not a "
+            f"graphite_tpu checkpoint, or torn mid-write")
+    return z
+
+
+def _check_schema(path: str, z) -> None:
+    if int(z["__meta_schema"]) != _SCHEMA_VERSION:
+        raise ValueError(
+            f"checkpoint schema {int(z['__meta_schema'])} != "
+            f"{_SCHEMA_VERSION}")
+
+
+def _load_leaves(path: str, z, template: SimState) -> SimState:
+    """Fill ``template``'s leaves from the archive, shape-verified."""
+    arrays, treedef = _flatten_with_paths(template)
+    leaves = []
+    for key, tmpl in arrays.items():
+        if key.startswith("__meta"):
+            continue
+        if key not in z:
+            raise ValueError(f"checkpoint missing field {key!r}")
+        try:
+            a = z[key]
+        except ValueError:
+            raise
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r} field {key!r} is unreadable "
+                f"({type(e).__name__}: {e}) — truncated or corrupt") \
+                from e
+        if a.shape != tmpl.shape:
+            raise ValueError(
+                f"checkpoint field {key!r} shape {a.shape} != expected "
+                f"{tmpl.shape} (params mismatch?)")
+        # Commit each leaf to a device array NOW, from an OWNED host
+        # copy: under GRAPHITE_DONATE_STATE=1 megarun/megastep
+        # donate their state argument, and donating a leaf that is
+        # still a host numpy view of the (mmap'd) npz is an aliasing
+        # hazard on the CPU backend (observed as nondeterministic
+        # wrong results / bitcast garbage in resumed runs — the same
+        # buffer-lifetime bug class that made donation opt-in,
+        # engine/quantum.py state_donation_enabled).
+        # jnp.array(copy=True) — not asarray, which zero-copies
+        # aligned host buffers.
+        leaves.append(jnp.array(a, dtype=tmpl.dtype, copy=True))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def save_checkpoint(path: str, state: SimState, steps: int = 0) -> None:
     arrays, _ = _flatten_with_paths(state)
     arrays["__meta_steps"] = np.int64(steps)
     arrays["__meta_schema"] = np.int64(_SCHEMA_VERSION)
-    np.savez_compressed(path, **arrays)
+    _atomic_savez(path, arrays)
 
 
 def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:
@@ -84,41 +189,71 @@ def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:
 
     The params must describe the same simulation (tile count, cache
     geometry, ...) that produced the checkpoint; shapes are verified.
+    Raises CheckpointCorruptError on an unreadable file, ValueError on a
+    schema or shape mismatch.
     """
-    with np.load(path) as z:
+    with _open_checkpoint(path) as z:
+        if "__meta_variants" in z.files:
+            raise ValueError(
+                f"{path!r} is a batched sweep checkpoint "
+                f"(V={int(z['__meta_variants'])}); load it with "
+                f"load_sweep_checkpoint")
+        _check_schema(path, z)
         saved_capi = z["ch_sent"].size > 0
         saved_streams = int(z["strm_cursor"].shape[0]) \
             if "strm_cursor" in z else 0
         template = make_state(params, has_capi=saved_capi,
                               num_streams=saved_streams
                               or params.num_tiles)
-        arrays, treedef = _flatten_with_paths(template)
-        if int(z["__meta_schema"]) != _SCHEMA_VERSION:
-            raise ValueError(
-                f"checkpoint schema {int(z['__meta_schema'])} != "
-                f"{_SCHEMA_VERSION}")
         steps = int(z["__meta_steps"])
-        leaves = []
-        for key, tmpl in arrays.items():
-            if key.startswith("__meta"):
-                continue
-            if key not in z:
-                raise ValueError(f"checkpoint missing field {key!r}")
-            a = z[key]
-            if a.shape != tmpl.shape:
-                raise ValueError(
-                    f"checkpoint field {key!r} shape {a.shape} != expected "
-                    f"{tmpl.shape} (params mismatch?)")
-            # Commit each leaf to a device array NOW, from an OWNED host
-            # copy: under GRAPHITE_DONATE_STATE=1 megarun/megastep
-            # donate their state argument, and donating a leaf that is
-            # still a host numpy view of the (mmap'd) npz is an aliasing
-            # hazard on the CPU backend (observed as nondeterministic
-            # wrong results / bitcast garbage in resumed runs — the same
-            # buffer-lifetime bug class that made donation opt-in,
-            # engine/quantum.py state_donation_enabled).
-            # jnp.array(copy=True) — not asarray, which zero-copies
-            # aligned host buffers.
-            leaves.append(jnp.array(a, dtype=tmpl.dtype, copy=True))
-    state = jax.tree_util.tree_unflatten(treedef, leaves)
+        state = _load_leaves(path, z, template)
     return state, steps
+
+
+# ------------------------------------------------- batched sweep state
+# (v25: the sweep service preempts a long-running V-wide bucket at a
+# window boundary and resumes it bit-identically — per-lane resume
+# identity is the solo guarantee carried through the stacked axis)
+
+def save_sweep_checkpoint(path: str, bstate: SimState,
+                          steps: int = 0) -> None:
+    """Save [V]-leading batched SweepSimulator state.  The leading axis
+    is recorded (__meta_variants) so a resume against the wrong bucket
+    width fails loudly instead of unflattening garbage."""
+    arrays, _ = _flatten_with_paths(bstate)
+    arrays["__meta_steps"] = np.int64(steps)
+    arrays["__meta_schema"] = np.int64(_SCHEMA_VERSION)
+    arrays["__meta_variants"] = np.int64(bstate.clock.shape[0])
+    _atomic_savez(path, arrays)
+
+
+def load_sweep_checkpoint(path: str, variants: List[SimParams],
+                          num_streams: int = 0
+                          ) -> Tuple[SimState, int]:
+    """Rebuild batched [V]-leading state for ``variants`` (the PADDED
+    bucket, in lane order).  The template is the same per-variant
+    make_state stack SweepSimulator builds, so shapes verify per leaf
+    with the [V] axis in front."""
+    with _open_checkpoint(path) as z:
+        if "__meta_variants" not in z.files:
+            raise ValueError(
+                f"{path!r} is a solo checkpoint; load it with "
+                f"load_checkpoint")
+        v = int(z["__meta_variants"])
+        if v != len(variants):
+            raise ValueError(
+                f"sweep checkpoint holds {v} lanes, bucket has "
+                f"{len(variants)} variants — resume must use the same "
+                f"padded bucket")
+        _check_schema(path, z)
+        saved_capi = z["ch_sent"].size > 0
+        saved_streams = int(z["strm_cursor"].shape[1]) \
+            if "strm_cursor" in z else 0
+        streams = num_streams or saved_streams or variants[0].num_tiles
+        states = [make_state(p, has_capi=saved_capi, num_streams=streams)
+                  for p in variants]
+        template = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *states)
+        steps = int(z["__meta_steps"])
+        bstate = _load_leaves(path, z, template)
+    return bstate, steps

@@ -391,16 +391,27 @@ class Simulator:
                                     num_streams=trace.num_tiles)
         self.steps = 0
         self.host_seconds = 0.0
+        # True when the last run() exited on an expired wall-clock
+        # budget (state intact at a window boundary, checkpointable).
+        self.preempted = False
 
     def run(self, max_steps: Optional[int] = None,
-            poll_every: int = 8) -> SimSummary:
-        """Run megasteps until every tile is DONE (or max_steps)."""
+            poll_every: int = 8,
+            budget_s: Optional[float] = None) -> SimSummary:
+        """Run megasteps until every tile is DONE (or max_steps).
+
+        ``budget_s``: wall-clock budget — on expiry the loop exits at
+        the next window boundary with ``self.preempted`` True; a
+        save_checkpoint / restore_checkpoint / run() sequence then
+        continues bit-identically (resume determinism is the
+        checkpoint module's contract)."""
         from graphite_tpu.log import get_logger
         from graphite_tpu.obs import span
         lg = get_logger("driver")
         lg.info("run: %d tiles, %d events/tile, protocol=%s",
                 self.params.num_tiles, self.trace.num_events,
                 self.params.protocol)
+        self.preempted = False
         t0 = time.perf_counter()
         last_progress = None
         qps = self.params.quanta_per_step
@@ -433,6 +444,10 @@ class Simulator:
             if bool(done):
                 break
             if max_steps is not None and self.steps >= max_steps:
+                break
+            if budget_s is not None \
+                    and time.perf_counter() - t0 >= budget_s:
+                self.preempted = True
                 break
             progress = (int(cursor_sum), int(clock_sum))
             if progress == last_progress:

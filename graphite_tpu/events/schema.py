@@ -112,6 +112,19 @@ class Trace:
 
     # ------------------------------------------------------------ utility
 
+    def content_hash(self) -> str:
+        """sha256 over the event arrays (values + shapes) — the trace's
+        durable identity.  Two traces with equal hashes produce
+        bit-identical simulations under equal params, so this keys the
+        sweep service's serve-from-cache tier (and matches the disk
+        trace cache's content-addressing philosophy)."""
+        import hashlib
+        h = hashlib.sha256()
+        for a in (self.ops, self.addr, self.arg, self.arg2):
+            h.update(str(a.shape).encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()
+
     def instruction_count(self) -> int:
         """Total modeled instructions across all tiles (for MIPS math).
         Line-split continuation events (arg2=1 on MEM_*) belong to the

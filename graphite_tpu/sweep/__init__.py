@@ -9,14 +9,19 @@ batched operands (engine/vparams.py) so one compiled program serves the
 whole batch, V variants advance per device dispatch, and each variant's
 results are bit-identical to its solo run.
 
-  * ``space``  — STRUCTURAL/VARIANT leaf partition + sweep-spec parsing
-  * ``batch``  — variant stacking, the vmapped megarun, result fan-out
-  * ``driver`` — request queue bucketing submissions by structural
-                 signature, pow2 padding, compile-cache accounting
+  * ``space``   — STRUCTURAL/VARIANT leaf partition + sweep-spec parsing
+  * ``batch``   — variant stacking, the vmapped megarun, result fan-out
+  * ``driver``  — request queue bucketing submissions by structural
+                  signature, pow2 padding, compile-cache accounting
+  * ``service`` — the fault-tolerant layer over all of it: crash-safe
+                  ticket journal, bucket bisection around poisoned
+                  lanes, preempt/checkpoint/resume, results_db
+                  serve-from-cache (ISSUE 15)
 """
 
 from graphite_tpu.sweep.batch import SweepSimulator, run_sweep  # noqa: F401
 from graphite_tpu.sweep.driver import SweepDriver  # noqa: F401
+from graphite_tpu.sweep.service import SweepService, Ticket  # noqa: F401
 from graphite_tpu.sweep.space import (  # noqa: F401
     STRUCTURAL_LEAVES, VARIANT_LEAVES, build_variants, iter_leaves,
-    parse_sweep_spec, structural_signature)
+    parse_sweep_spec, structural_signature, variant_signature)

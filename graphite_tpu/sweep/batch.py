@@ -43,6 +43,7 @@ from graphite_tpu.events.schema import Trace
 from graphite_tpu.params import SimParams
 from graphite_tpu.sweep.space import (canonical_params, structural_diff,
                                       structural_signature)
+from graphite_tpu.testing import faults
 
 # In-process compile accounting: bumped when the batched program is
 # TRACED (tracing happens exactly once per jit cache miss — i.e. per
@@ -154,21 +155,37 @@ class SweepSimulator:
         self.bvp = _stack([variant_params(p) for p in variants])
         self.steps = 0
         self.host_seconds = 0.0
+        # Set by run() when a wall-clock budget expired before every
+        # lane finished: the batch stopped at a window boundary and the
+        # state is checkpointable/resumable bit-identically.
+        self.preempted = False
 
     @property
     def num_variants(self) -> int:
         return len(self.variants)
 
     def run(self, max_steps: Optional[int] = None,
-            poll_every: int = 8) -> List[SimSummary]:
+            poll_every: int = 8,
+            budget_s: Optional[float] = None) -> List[SimSummary]:
         """Run windows until EVERY variant is done (or max_steps); one
-        SimSummary per variant, in submission order."""
+        SimSummary per variant, in submission order.
+
+        ``budget_s`` is a wall-clock budget: when it expires the loop
+        exits at the next WINDOW BOUNDARY with ``self.preempted`` True
+        and the batched state intact — save_checkpoint + a later
+        restore_checkpoint + run() continues bit-identically (the
+        megarun quantum budget is relative to the entry state, and the
+        engine is deterministic quantum-by-quantum, so where the
+        windows are cut cannot change any lane's math)."""
         from graphite_tpu.log import get_logger
         from graphite_tpu.obs import span
         lg = get_logger("sweep")
         base = self.variants[0]
         lg.info("sweep: %d variants x %d tiles, %d events/tile",
                 self.num_variants, base.num_tiles, self.trace.num_events)
+        if faults.armed():
+            faults.maybe_raise_poison(self.variants)
+        self.preempted = False
         t0 = time.perf_counter()
         qps = base.quanta_per_step
         last_progress = None
@@ -190,12 +207,20 @@ class SweepSimulator:
                     (self.bstate.cursor.sum(), self.bstate.clock.sum(),
                      self.bstate.ctr_quantum))
             first_dispatch = False
+            if faults.armed():
+                faults.fire("raise_in_bucket")
+                faults.fire("sigkill_in_bucket")
             # The device loop runs to the slowest variant; window
             # accounting follows that lane.
             self.steps = -(-int(np.max(quanta_v)) // qps)
             if bool(done_v.all()):
                 break
             if max_steps is not None and self.steps >= max_steps:
+                break
+            if (budget_s is not None
+                    and time.perf_counter() - t0 >= budget_s) \
+                    or faults.check("exhaust_budget"):
+                self.preempted = True
                 break
             progress = (int(cursor_sum), int(clock_sum))
             if progress == last_progress:
@@ -219,6 +244,22 @@ class SweepSimulator:
         return [SimSummary(self.variants[i], _lane(self.bstate, i),
                            self.host_seconds, self.steps)
                 for i in range(self.num_variants)]
+
+    # ---------------------------------------------- checkpoint/resume
+    # (schema v25: the solo flatten+save with the [V] lane axis leading
+    # every leaf — the sweep service preempts long buckets through this)
+
+    def save_checkpoint(self, path: str) -> None:
+        from graphite_tpu.engine.checkpoint import save_sweep_checkpoint
+        save_sweep_checkpoint(path, self.bstate, self.steps)
+
+    def restore_checkpoint(self, path: str) -> None:
+        """Restore batched state saved from THIS bucket shape (same
+        padded variant list, same trace).  run() then continues from the
+        checkpointed window boundary bit-identically."""
+        from graphite_tpu.engine.checkpoint import load_sweep_checkpoint
+        self.bstate, self.steps = load_sweep_checkpoint(
+            path, self.variants, num_streams=self.trace.addr.shape[0])
 
 
 def run_sweep(variants: List[SimParams], trace: Trace,

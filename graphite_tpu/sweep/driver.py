@@ -55,6 +55,11 @@ class SweepDriver:
         # THIS driver's process — the compile-cache bound being asserted.
         self._compiled_shapes: set = set()
         self.compiles_observed = 0
+        # Results of buckets that COMPLETED during a drain that later
+        # raised: their tickets already left the queue, so the results
+        # must survive to the retry drain instead of vanishing with the
+        # exception (the drain() docstring's promise, now kept).
+        self._completed: Dict[int, SimSummary] = {}
 
     def submit(self, params: SimParams) -> int:
         """Queue one variant; returns a ticket redeemable at drain()."""
@@ -72,7 +77,9 @@ class SweepDriver:
         submission order (padding lanes are dropped).  Submissions leave
         the queue only as their bucket COMPLETES — a mid-drain failure
         (a DeadlockError in one bucket) leaves the failed and not-yet-run
-        buckets queued for a retry drain instead of discarding them."""
+        buckets queued for a retry drain instead of discarding them;
+        buckets that completed BEFORE the failure are stashed and
+        returned by that retry drain (their tickets stay redeemable)."""
         buckets: Dict[tuple, List[Tuple[int, SimParams]]] = {}
         order: List[tuple] = []
         for ticket, p in self._pending:
@@ -82,7 +89,6 @@ class SweepDriver:
                 order.append(sig)
             buckets[sig].append((ticket, p))
 
-        results: Dict[int, SimSummary] = {}
         for sig in order:
             items = buckets[sig]
             v = len(items)
@@ -112,8 +118,10 @@ class SweepDriver:
             self._compiled_shapes.add(shape_key)
             done_tickets = set()
             for (ticket, _), summary in zip(items, summaries[:v]):
-                results[ticket] = summary
+                self._completed[ticket] = summary
                 done_tickets.add(ticket)
             self._pending = [(t, p) for t, p in self._pending
                              if t not in done_tickets]
+        results = dict(self._completed)
+        self._completed.clear()
         return results

@@ -1,0 +1,594 @@
+"""Fault-tolerant sweep service: crash-safe tickets over the sweep engine.
+
+The SweepDriver (sweep/driver.py) is a correct but fragile batcher: one
+poisoned lane sinks its whole padded bucket, a crash loses every queued
+ticket, and nothing survives the process.  This module is the ROADMAP's
+"sweep-as-a-service" layer made safe to lean on — the four pillars of
+ISSUE 15:
+
+  1. **Ticket lifecycle + durable journal.**  Tickets move through
+     QUEUED / RUNNING / DONE / FAILED / QUARANTINED.  Every transition
+     is appended to a journal directory as its own JSON record, written
+     atomically (tmp + fsync + rename, the events/trace_cache.py
+     pattern) — a crash between any two syscalls leaves a replayable
+     prefix, never a torn record.  A restarted service replays the
+     journal: DONE tickets are never re-run, in-flight (RUNNING) work is
+     re-queued or resumed from its preemption checkpoint.
+  2. **Poison-lane isolation.**  A bucket that raises (DeadlockError or
+     an injected fault) is retried with exponential backoff — transient
+     faults clear — then BISECTED: halves re-run until the failing
+     variant is isolated, which is QUARANTINED with its error attached
+     while every healthy lane is served.  Bisection recurses over the
+     REAL tickets and re-pads each half, so a fault in a padding lane
+     (a copy of the last real variant) quarantines that real ticket
+     exactly once.
+  3. **Preempt / checkpoint / resume.**  Buckets run under an optional
+     wall-clock budget; on expiry the batched [V]-leading state is
+     checkpointed (schema v25, engine/checkpoint.py) at a window
+     boundary and the bucket resumes — in this process or after a
+     restart — bit-identically per lane.  A corrupt checkpoint
+     (CheckpointCorruptError) is discarded and the bucket re-runs from
+     scratch: the journal, not the checkpoint, is the source of truth.
+  4. **Serve-from-cache tier.**  tools/results_db.py doubles as a
+     persistent result cache keyed on (structural signature, variant
+     signature, trace content hash): re-submitting an already-completed
+     design point returns the stored summary with zero compiles and
+     zero simulated windows.
+
+One service process owns one journal directory at a time (no
+cross-process locking — the deployment story is one serving process per
+queue, restarted by a supervisor).  The fault-injection harness
+(graphite_tpu/testing/faults.py) reaches every failure path above from
+tests and the run_tests.sh kill-and-recover gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from graphite_tpu.config import Config, load_config
+from graphite_tpu.engine.checkpoint import CheckpointCorruptError
+from graphite_tpu.engine.sim import DeadlockError
+from graphite_tpu.events.schema import Trace
+from graphite_tpu.params import SimParams
+from graphite_tpu.sweep import batch as batchmod
+from graphite_tpu.sweep.batch import SweepSimulator
+from graphite_tpu.sweep.driver import _ceil_pow2
+from graphite_tpu.sweep.space import (structural_signature, variant_label,
+                                      variant_signature)
+from graphite_tpu.testing.faults import FaultInjected
+
+__all__ = ["SweepService", "Ticket", "QUEUED", "RUNNING", "DONE",
+           "FAILED", "QUARANTINED"]
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"          # transient failure exhausted its retries
+QUARANTINED = "quarantined"  # config-attributed: isolated by bisection
+
+TERMINAL = frozenset({DONE, FAILED, QUARANTINED})
+
+
+@dataclass
+class Ticket:
+    """One queued design point.  Durable identity is the OVERRIDES dict
+    (JSON-able config paths -> values) — params are rebuilt from the
+    journal's base config on restart, never serialized."""
+
+    ticket: int
+    overrides: Dict[str, str]
+    label: str
+    status: str = QUEUED
+    summary: Optional[dict] = None
+    error: Optional[str] = None
+    from_cache: bool = False
+    params: Optional[SimParams] = field(default=None, repr=False)
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.json")
+    pending = tmp
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        pending = None
+    finally:
+        if pending is not None:
+            try:
+                os.unlink(pending)
+            except OSError:
+                pass
+
+
+_results_db_mod = None
+
+
+def _results_db():
+    """tools/results_db.py, loaded by path (tools/ is not a package);
+    None when the tree ships without it — the cache tier then simply
+    stays cold."""
+    global _results_db_mod
+    if _results_db_mod is None:
+        import importlib.util
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "tools", "results_db.py")
+        if not os.path.exists(path):
+            return None
+        spec = importlib.util.spec_from_file_location(
+            "graphite_tpu_results_db", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _results_db_mod = mod
+    return _results_db_mod
+
+
+class SweepService:
+    """Crash-safe ticket queue over SweepSimulator buckets.
+
+    Usage::
+
+        svc = SweepService(trace, journal_dir, cfg=cfg, db_path=db)
+        for overrides in points:
+            svc.submit(overrides)
+        tickets = svc.serve()        # {id: Ticket}, all terminal or
+                                     # preempted-resumable
+
+    Restarting with the same journal_dir replays the journal: DONE
+    tickets keep their summaries, RUNNING tickets resume from their
+    preemption checkpoint or re-queue, QUEUED tickets run.
+    """
+
+    def __init__(self, trace: Trace, journal_dir: str,
+                 cfg: Optional[Config] = None,
+                 db_path: Optional[str] = None,
+                 budget_s: Optional[float] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 poll_every: Optional[int] = None,
+                 max_steps: Optional[int] = None,
+                 sleep=time.sleep):
+        from graphite_tpu.log import get_logger
+        self._lg = get_logger("service")
+        self.trace = trace
+        self.trace_hash = trace.content_hash()
+        self.journal_dir = os.path.abspath(journal_dir)
+        os.makedirs(self.journal_dir, exist_ok=True)
+        cfg = cfg if cfg is not None else load_config()
+        meta_path = os.path.join(self.journal_dir, "meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("trace_hash") != self.trace_hash:
+                raise ValueError(
+                    f"journal {self.journal_dir!r} was recorded for a "
+                    f"different trace (hash "
+                    f"{meta.get('trace_hash', '?')[:12]} != "
+                    f"{self.trace_hash[:12]}) — one journal serves one "
+                    f"workload")
+            # The journal's base config wins: tickets are override
+            # DELTAS, so replaying them against a different base would
+            # silently rewrite every recovered design point.
+            self.cfg = Config.from_text(meta["base_config"])
+        else:
+            self.cfg = cfg.copy()
+            _atomic_write_json(meta_path, {
+                "trace_hash": self.trace_hash,
+                "base_config": self.cfg.to_text()})
+        c = self.cfg
+        self.budget_s = budget_s if budget_s is not None \
+            else (c.get_float("service/budget_s", 0.0) or None)
+        self.max_retries = max_retries if max_retries is not None \
+            else c.get_int("service/max_retries", 2)
+        self.backoff_s = backoff_s if backoff_s is not None \
+            else c.get_float("service/backoff_ms", 50.0) / 1000.0
+        self.poll_every = poll_every if poll_every is not None \
+            else c.get_int("service/poll_every", 8)
+        self.max_steps = max_steps
+        self.db_path = db_path
+        self._db = None
+        self._sleep = sleep
+        self._tickets: Dict[int, Ticket] = {}
+        self._next_ticket = 0
+        self._seq = 0
+        # Preempted buckets awaiting resume: [{tickets, checkpoint,
+        # steps}] in preemption order.
+        self._resumable: List[dict] = []
+        self.compiles_observed = 0
+        self.stats = {"buckets_run": 0, "cache_hits": 0, "retries": 0,
+                      "bisections": 0, "preemptions": 0,
+                      "quarantined": 0, "failed": 0,
+                      "checkpoints_discarded": 0, "recovered": 0}
+        self._recover()
+
+    # ------------------------------------------------------------ journal
+
+    def _journal(self, event: str, **fields) -> None:
+        self._seq += 1
+        rec = {"seq": self._seq, "event": event}
+        rec.update(fields)
+        _atomic_write_json(
+            os.path.join(self.journal_dir, f"rec-{self._seq:08d}.json"),
+            rec)
+
+    def _recover(self) -> None:
+        """Replay the journal into in-memory ticket state.  Record files
+        are whole-or-absent (atomic rename), so replay is a straight
+        fold in sequence order."""
+        names = sorted(n for n in os.listdir(self.journal_dir)
+                       if n.startswith("rec-") and n.endswith(".json"))
+        recs = []
+        for n in names:
+            with open(os.path.join(self.journal_dir, n)) as f:
+                recs.append(json.load(f))
+        recs.sort(key=lambda r: r.get("seq", 0))
+        for rec in recs:
+            ev = rec.get("event")
+            if ev == "submit":
+                t = Ticket(ticket=rec["ticket"],
+                           overrides=dict(rec["overrides"]),
+                           label=rec.get("label", ""))
+                self._tickets[t.ticket] = t
+            elif ev == "running":
+                for tid in rec.get("tickets", ()):
+                    if tid in self._tickets:
+                        self._tickets[tid].status = RUNNING
+            elif ev == "done":
+                t = self._tickets.get(rec["ticket"])
+                if t is not None:
+                    t.status = DONE
+                    t.summary = rec.get("summary")
+                    t.from_cache = bool(rec.get("from_cache"))
+                self._drop_resumable(rec["ticket"])
+            elif ev in ("failed", "quarantined"):
+                t = self._tickets.get(rec["ticket"])
+                if t is not None:
+                    t.status = FAILED if ev == "failed" else QUARANTINED
+                    t.error = rec.get("error")
+                self._drop_resumable(rec["ticket"])
+            elif ev == "preempted":
+                self._drop_resumable(*rec.get("tickets", ()))
+                self._resumable.append({
+                    "tickets": list(rec["tickets"]),
+                    "checkpoint": rec["checkpoint"],
+                    "steps": rec.get("steps", 0)})
+            elif ev == "requeued":
+                for tid in rec.get("tickets", ()):
+                    if tid in self._tickets:
+                        self._tickets[tid].status = QUEUED
+                self._drop_resumable(*rec.get("tickets", ()))
+        if self._tickets:
+            self._next_ticket = max(self._tickets) + 1
+        if recs:
+            self._seq = max(r.get("seq", 0) for r in recs)
+        # Resumable buckets whose checkpoint vanished can't resume.
+        self._resumable = [r for r in self._resumable
+                           if os.path.exists(r["checkpoint"])]
+        covered = {tid for r in self._resumable for tid in r["tickets"]}
+        # In-flight work with no checkpoint: the process died mid-bucket
+        # — re-queue it (crash-safety pillar 1).
+        requeue = [t.ticket for t in self._tickets.values()
+                   if t.status == RUNNING and t.ticket not in covered]
+        if requeue:
+            self._journal("requeued", tickets=requeue,
+                          reason="recovered in-flight work")
+            for tid in requeue:
+                self._tickets[tid].status = QUEUED
+            self.stats["recovered"] += len(requeue)
+        if self._tickets:
+            self._lg.info(
+                "service recovered %d tickets (%d requeued, %d "
+                "resumable buckets) from %s", len(self._tickets),
+                len(requeue), len(self._resumable), self.journal_dir)
+
+    def _drop_resumable(self, *tids) -> None:
+        tids = set(tids)
+        self._resumable = [r for r in self._resumable
+                           if not tids & set(r["tickets"])]
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, overrides: Dict[str, str],
+               label: Optional[str] = None) -> int:
+        """Queue one design point (config-path override deltas over the
+        journal's base config); returns the ticket id.  Params build
+        eagerly so malformed overrides fail the submitter, not the
+        serving loop."""
+        overrides = {k: str(v) for k, v in overrides.items()}
+        t = Ticket(ticket=self._next_ticket, overrides=overrides,
+                   label=label or variant_label(overrides))
+        t.params = self._build_params(overrides)
+        self._next_ticket += 1
+        self._tickets[t.ticket] = t
+        self._journal("submit", ticket=t.ticket, overrides=overrides,
+                      label=t.label)
+        return t.ticket
+
+    def _build_params(self, overrides: Dict[str, str]) -> SimParams:
+        c = self.cfg.copy()
+        for k, v in overrides.items():
+            c.set(k, v)
+        return SimParams.from_config(c, num_tiles=self.trace.num_tiles)
+
+    def _params(self, t: Ticket) -> SimParams:
+        if t.params is None:
+            t.params = self._build_params(t.overrides)
+        return t.params
+
+    # -------------------------------------------------------- cache tier
+
+    def _cache_key(self, params: SimParams) -> str:
+        import hashlib
+
+        def digest(sig) -> str:
+            return hashlib.sha256(repr(sig).encode()).hexdigest()[:12]
+
+        return (f"svc:{digest(structural_signature(params))}:"
+                f"{digest(variant_signature(params))}:"
+                f"{self.trace_hash[:12]}")
+
+    def _open_db(self):
+        if self.db_path is None:
+            return None
+        if self._db is None:
+            mod = _results_db()
+            if mod is None:
+                return None
+            self._db = mod.open_db(self.db_path)
+        return self._db
+
+    def _serve_cached(self, t: Ticket) -> bool:
+        db = self._open_db()
+        if db is None:
+            return False
+        key = self._cache_key(self._params(t))
+        row = db.execute(
+            "SELECT raw_json FROM runs WHERE workload = ? "
+            "ORDER BY ts DESC, id DESC LIMIT 1", (key,)).fetchone()
+        if row is None:
+            return False
+        t.status = DONE
+        t.summary = json.loads(row[0])
+        t.from_cache = True
+        self.stats["cache_hits"] += 1
+        self._journal("done", ticket=t.ticket, summary=t.summary,
+                      from_cache=True)
+        return True
+
+    def _store(self, t: Ticket, row: dict) -> None:
+        db = self._open_db()
+        if db is None:
+            return
+        mod = _results_db()
+        mod.add_run(db, self._cache_key(self._params(t)), row)
+
+    # ------------------------------------------------------------ serving
+
+    def tickets(self) -> Dict[int, Ticket]:
+        return dict(self._tickets)
+
+    def open_tickets(self) -> List[Ticket]:
+        return [t for t in self._tickets.values()
+                if t.status not in TERMINAL]
+
+    def drain(self) -> Dict[int, Ticket]:
+        """One full serving pass: resume preempted buckets, serve
+        cache hits, run every queued bucket (with retry / bisection /
+        quarantine).  Tickets still RUNNING afterwards were preempted
+        this pass and have a checkpoint on disk — drain again (or
+        serve()) to continue them."""
+        for rec in list(self._resumable):
+            self._resume_bucket(rec)
+        for t in sorted(self._tickets.values(), key=lambda t: t.ticket):
+            if t.status == QUEUED:
+                self._serve_cached(t)
+        queued = [t for t in sorted(self._tickets.values(),
+                                    key=lambda t: t.ticket)
+                  if t.status == QUEUED]
+        buckets: Dict[tuple, List[Ticket]] = {}
+        order: List[tuple] = []
+        for t in queued:
+            sig = structural_signature(self._params(t))
+            if sig not in buckets:
+                buckets[sig] = []
+                order.append(sig)
+            buckets[sig].append(t)
+        for sig in order:
+            self._run_bucket(buckets[sig])
+        return self.tickets()
+
+    def serve(self) -> Dict[int, Ticket]:
+        """drain() until every ticket is terminal.  Each pass makes at
+        least one window of progress per preempted bucket (the budget
+        check sits after the dispatch), so this terminates."""
+        while True:
+            self.drain()
+            if not self.open_tickets():
+                return self.tickets()
+
+    # ----------------------------------------------------- bucket running
+
+    def _padded(self, items: List[Ticket]) -> List[SimParams]:
+        variants = [self._params(t) for t in items]
+        vpad = _ceil_pow2(len(variants))
+        return variants + [variants[-1]] * (vpad - len(variants))
+
+    def _mark_running(self, items: List[Ticket]) -> None:
+        fresh = [t.ticket for t in items if t.status != RUNNING]
+        for t in items:
+            t.status = RUNNING
+        if fresh:
+            self._journal("running", tickets=fresh)
+
+    def _run_bucket(self, items: List[Ticket]) -> None:
+        """Run one structural bucket to a terminal or preempted state,
+        with bounded retries (exponential backoff) and bisection on
+        persistent failure.  Recursion re-pads each half, so padding
+        lanes never multiply a quarantine."""
+        self._mark_running(items)
+        attempt = 0
+        while True:
+            try:
+                sim = SweepSimulator(self._padded(items), self.trace)
+                self._execute(items, sim)
+                return
+            except (DeadlockError, FaultInjected) as e:
+                attempt += 1
+                if attempt <= self.max_retries:
+                    delay = self.backoff_s * (2 ** (attempt - 1))
+                    self.stats["retries"] += 1
+                    self._lg.warning(
+                        "bucket %s failed (%s); retry %d/%d in %.3fs",
+                        [t.ticket for t in items], e, attempt,
+                        self.max_retries, delay)
+                    if delay > 0:
+                        self._sleep(delay)
+                    continue
+                if len(items) > 1:
+                    mid = len(items) // 2
+                    self.stats["bisections"] += 1
+                    self._lg.warning(
+                        "bucket %s still failing after %d retries; "
+                        "bisecting", [t.ticket for t in items],
+                        self.max_retries)
+                    self._run_bucket(items[:mid])
+                    self._run_bucket(items[mid:])
+                    return
+                self._terminal_failure(items[0], e)
+                return
+
+    def _execute(self, items: List[Ticket], sim: SweepSimulator) -> None:
+        before = batchmod.compile_count()
+        summaries = sim.run(max_steps=self.max_steps,
+                            poll_every=self.poll_every,
+                            budget_s=self.budget_s)
+        self.compiles_observed += batchmod.compile_count() - before
+        self.stats["buckets_run"] += 1
+        if sim.preempted:
+            self._preempt(items, sim)
+            return
+        for t, s in zip(items, summaries[:len(items)]):
+            self._complete(t, self._summary_row(s))
+
+    def _summary_row(self, s) -> dict:
+        row = s.to_dict()
+        row["kind"] = "service_ticket"
+        # Per-tile final clocks ride the record so per-lane bit-identity
+        # is checkable from the stored summary alone (the acceptance
+        # unit of the kill-and-recover gate).
+        row["clock_ps"] = np.asarray(s.clock).astype(
+            np.int64).reshape(-1).tolist()
+        return row
+
+    def _complete(self, t: Ticket, row: dict) -> None:
+        t.status = DONE
+        t.summary = row
+        t.from_cache = False
+        self._journal("done", ticket=t.ticket, summary=row,
+                      from_cache=False)
+        self._store(t, row)
+
+    def _terminal_failure(self, t: Ticket, e: Exception) -> None:
+        err = f"{type(e).__name__}: {e}"
+        t.error = err
+        if isinstance(e, FaultInjected) and e.transient:
+            # Retries exhausted on a TRANSIENT fault: the config is not
+            # proven poisonous — mark failed, not quarantined, so an
+            # operator resubmits rather than blacklists.
+            t.status = FAILED
+            self.stats["failed"] += 1
+            self._journal("failed", ticket=t.ticket, error=err)
+        else:
+            t.status = QUARANTINED
+            self.stats["quarantined"] += 1
+            self._journal("quarantined", ticket=t.ticket, error=err)
+        self._lg.error("ticket %d (%s) %s: %s", t.ticket, t.label,
+                       t.status, err)
+
+    # --------------------------------------------------- preempt / resume
+
+    def _ckpt_path(self, items: List[Ticket]) -> str:
+        return os.path.join(self.journal_dir,
+                            f"bucket-{items[0].ticket:08d}"
+                            f"x{len(items)}.ckpt.npz")
+
+    def _preempt(self, items: List[Ticket], sim: SweepSimulator) -> None:
+        path = self._ckpt_path(items)
+        sim.save_checkpoint(path)
+        rec = {"tickets": [t.ticket for t in items], "checkpoint": path,
+               "steps": sim.steps}
+        self._journal("preempted", **rec)
+        self._drop_resumable(*rec["tickets"])
+        self._resumable.append(rec)
+        self.stats["preemptions"] += 1
+        self._lg.info("bucket %s preempted at step %d -> %s",
+                      rec["tickets"], sim.steps, path)
+
+    def _resume_bucket(self, rec: dict) -> None:
+        items = [self._tickets[tid] for tid in rec["tickets"]
+                 if tid in self._tickets]
+        if not items or all(t.status in TERMINAL for t in items):
+            self._resumable.remove(rec)
+            return
+        self._mark_running(items)
+        try:
+            sim = SweepSimulator(self._padded(items), self.trace)
+            sim.restore_checkpoint(rec["checkpoint"])
+        except (CheckpointCorruptError, ValueError) as e:
+            # Torn/corrupt (or mismatched) checkpoint: discard it and
+            # fall back to a from-scratch run — the journal stays the
+            # source of truth, the checkpoint is only an optimization.
+            self._lg.warning("discarding checkpoint %s (%s); re-running "
+                             "bucket %s from scratch", rec["checkpoint"],
+                             e, rec["tickets"])
+            self.stats["checkpoints_discarded"] += 1
+            self._drop_resumable(*rec["tickets"])
+            try:
+                os.unlink(rec["checkpoint"])
+            except OSError:
+                pass
+            self._journal("requeued", tickets=rec["tickets"],
+                          reason=f"checkpoint corrupt: {e}")
+            self._run_bucket(items)
+            return
+        self._drop_resumable(*rec["tickets"])
+        try:
+            self._execute(items, sim)
+        except (DeadlockError, FaultInjected) as e:
+            self._lg.warning("resumed bucket %s failed (%s); re-running "
+                             "from scratch", rec["tickets"], e)
+            self._run_bucket(items)
+            return
+        finally:
+            # The consumed checkpoint is garbage once the bucket either
+            # completed or re-checkpointed under a new path/record.
+            if not any(r["checkpoint"] == rec["checkpoint"]
+                       for r in self._resumable):
+                try:
+                    os.unlink(rec["checkpoint"])
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------ results
+
+    def result_rows(self) -> Dict[str, dict]:
+        """{label: summary row} for every DONE ticket (labels collide
+        only when one design point was submitted twice; later tickets
+        win, which is also the fresher summary)."""
+        out = {}
+        for t in sorted(self._tickets.values(), key=lambda t: t.ticket):
+            if t.status == DONE and t.summary is not None:
+                out[t.label] = t.summary
+        return out

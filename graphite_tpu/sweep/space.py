@@ -169,6 +169,17 @@ def structural_signature(params: SimParams) -> tuple:
         if classify(path, value) != "variant"))
 
 
+def variant_signature(params: SimParams) -> tuple:
+    """Hashable signature of every VARIANT leaf — the other half of the
+    partition.  (structural_signature, variant_signature, trace content
+    hash) is the durable identity of one design point: the sweep
+    service's results_db cache key, stable across processes and
+    restarts."""
+    return tuple(sorted(
+        (path, repr(value)) for path, value in iter_leaves(params)
+        if classify(path, value) == "variant"))
+
+
 def structural_diff(a: SimParams, b: SimParams) -> List[str]:
     """Human-readable list of structural leaves where ``a`` and ``b``
     disagree (empty = batchable together)."""

@@ -1,0 +1,10 @@
+"""Test-support package: the fault-injection harness (testing.faults).
+
+Distinct from ``graphite_tpu.engine.testing`` (engine-level cache
+warmers): this package holds the hooks PRODUCTION code calls so that
+tests and the CI recovery gate can make the service layer fail on
+demand — nothing here runs unless a fault is armed.
+"""
+
+from graphite_tpu.testing import faults  # noqa: F401
+from graphite_tpu.testing.faults import FaultInjected  # noqa: F401

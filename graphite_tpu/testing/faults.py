@@ -1,0 +1,206 @@
+"""Fault-injection harness for the sweep service's robustness story.
+
+Every recovery claim in ``sweep/service.py`` (ISSUE 15) is exercised, not
+asserted: production code calls the hooks below at its fault-relevant
+sites, and the hooks do NOTHING unless a fault is armed — either
+programmatically (``arm``/``disarm``, for in-process tests) or through
+the ``GRAPHITE_FAULTS`` environment variable (inherited by subprocess
+legs, which is how the run_tests.sh kill-and-recover gate reaches into a
+service process it is about to SIGKILL).
+
+Spec grammar — ``site[:arg]`` terms joined by ``;``::
+
+    GRAPHITE_FAULTS="raise_in_bucket:2"           # raise at the 2nd window
+    GRAPHITE_FAULTS="sigkill_in_bucket:2"         # SIGKILL self at the 2nd
+    GRAPHITE_FAULTS="truncate_checkpoint"         # corrupt the next save
+    GRAPHITE_FAULTS="exhaust_budget:3"            # budget reads empty from
+                                                  # the 3rd window check on
+    GRAPHITE_FAULTS="poison:dram/latency=120"     # every bucket containing
+                                                  # a variant whose
+                                                  # dram.latency_ns leaf
+                                                  # matches raises
+
+Sites and semantics:
+
+  * ``raise_in_bucket[:N]`` — one-shot TRANSIENT fault: the Nth window
+    dispatch of any SweepSimulator raises ``FaultInjected``; later hits
+    pass.  Exercises the service's bounded-retry/backoff path.
+  * ``sigkill_in_bucket[:N]`` — the process SIGKILLs itself at the Nth
+    window boundary: no cleanup, no atexit — the honest crash the
+    journal must survive.
+  * ``truncate_checkpoint[:N]`` — the Nth checkpoint written after
+    arming is truncated to a third of its bytes AFTER the atomic rename,
+    modeling torn storage under the writer: loads must surface
+    ``CheckpointCorruptError``, and the service must fall back to
+    re-running the bucket.
+  * ``exhaust_budget[:N]`` — from the Nth budget check on, the wall-clock
+    budget reads as exhausted: deterministic preemption without
+    wall-clock-sensitive tests.
+  * ``poison:<config-path>=<value>`` — a PERSISTENT per-variant fault:
+    any bucket holding a variant whose SimParams leaf for that config
+    path equals the value raises at dispatch.  Real DeadlockErrors
+    cannot be provoked per-LANE (all lanes share one trace), so this is
+    the deterministic poison lane the bucket-bisection path needs.
+
+Counters are per-process and reset by ``disarm()``; the env var is
+re-read on every check so a parent can arm a child leg purely through
+its environment.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Dict, List
+
+__all__ = ["FaultInjected", "arm", "disarm", "armed", "fire", "check",
+           "poison_lanes", "maybe_raise_poison", "maybe_truncate"]
+
+
+class FaultInjected(RuntimeError):
+    """An armed fault fired.  ``transient`` marks faults that succeed on
+    retry (one-shot raise_in_bucket); persistent faults (poison lanes)
+    re-fire every attempt and must be bisected/quarantined instead."""
+
+    def __init__(self, msg: str, site: str = "", transient: bool = False):
+        super().__init__(msg)
+        self.site = site
+        self.transient = transient
+
+
+# Programmatic arms (tests in-process) layered OVER the env specs
+# (subprocess legs); hit counters are shared across both.
+_armed: Dict[str, str] = {}
+_env_raw = None
+_env_specs: Dict[str, str] = {}
+_hits: Dict[str, int] = {}
+
+
+def _parse(raw: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for term in raw.split(";"):
+        term = term.strip()
+        if not term:
+            continue
+        site, _, arg = term.partition(":")
+        out[site.strip()] = arg.strip()
+    return out
+
+
+def _specs() -> Dict[str, str]:
+    global _env_raw, _env_specs
+    raw = os.environ.get("GRAPHITE_FAULTS", "")
+    if raw != _env_raw:
+        _env_raw = raw
+        _env_specs = _parse(raw)
+    if _armed:
+        merged = dict(_env_specs)
+        merged.update(_armed)
+        return merged
+    return _env_specs
+
+
+def arm(spec: str) -> None:
+    """Arm fault(s) in-process (same grammar as GRAPHITE_FAULTS)."""
+    _armed.update(_parse(spec))
+
+
+def disarm() -> None:
+    """Drop every programmatic arm and reset all hit counters."""
+    _armed.clear()
+    _hits.clear()
+
+
+def armed() -> bool:
+    return bool(_specs())
+
+
+def _nth(arg: str) -> int:
+    try:
+        return max(int(arg), 1) if arg else 1
+    except ValueError:
+        return 1
+
+
+def fire(site: str) -> None:
+    """Count one pass through ``site``; on the armed Nth pass, fault."""
+    specs = _specs()
+    if site not in specs:
+        return
+    n = _hits.get(site, 0) + 1
+    _hits[site] = n
+    if n != _nth(specs[site]):
+        return
+    if site.startswith("sigkill"):
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise FaultInjected(f"injected fault at {site!r} (hit {n})",
+                        site=site, transient=True)
+
+
+def check(site: str) -> bool:
+    """Sticky predicate: True on every pass from the armed Nth on."""
+    specs = _specs()
+    if site not in specs:
+        return False
+    n = _hits.get(site, 0) + 1
+    _hits[site] = n
+    return n >= _nth(specs[site])
+
+
+def poison_lanes(variants) -> List[bool]:
+    """Per-variant flags for the armed ``poison:<path>=<value>`` spec —
+    matched against the variant's SimParams leaves (config paths map to
+    dotted leaf paths by their last component, e.g. ``dram/latency``
+    matches ``dram.latency_ns`` via the numeric value)."""
+    from graphite_tpu.sweep.space import iter_leaves
+    arg = _specs().get("poison")
+    if not arg:
+        return [False] * len(variants)
+    leaf, _, want = arg.partition("=")
+    leaf = leaf.strip().replace("/", ".")
+    want = want.strip()
+    section, _, tail = leaf.rpartition(".")
+
+    def matches(params) -> bool:
+        for path, value in iter_leaves(params):
+            if section and not path.startswith(section + "."):
+                continue
+            if not (path == leaf or path.rsplit(".", 1)[-1]
+                    .startswith(tail)):
+                continue
+            try:
+                if float(value) == float(want):
+                    return True
+            except (TypeError, ValueError):
+                if str(value) == want:
+                    return True
+        return False
+
+    return [matches(p) for p in variants]
+
+
+def maybe_raise_poison(variants) -> None:
+    """Raise a PERSISTENT FaultInjected when any lane matches the armed
+    poison spec — called at bucket dispatch, so the whole batch fails
+    exactly the way a real poisoned lane sinks its bucket."""
+    flags = poison_lanes(variants)
+    if any(flags):
+        idx = [i for i, f in enumerate(flags) if f]
+        raise FaultInjected(
+            f"injected poison fault: lanes {idx} match armed spec "
+            f"{_specs().get('poison')!r}", site="poison", transient=False)
+
+
+def maybe_truncate(path: str) -> None:
+    """Truncate ``path`` (post-rename) when truncate_checkpoint is armed
+    — the torn-storage model the corrupt-load path must survive."""
+    specs = _specs()
+    if "truncate_checkpoint" not in specs:
+        return
+    n = _hits.get("truncate_checkpoint", 0) + 1
+    _hits["truncate_checkpoint"] = n
+    if n != _nth(specs["truncate_checkpoint"]):
+        return
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 3, 1))

@@ -1,9 +1,13 @@
 """Checkpoint/resume: stop mid-simulation, restore, and finish with
-bit-identical results vs an uninterrupted run."""
+bit-identical results vs an uninterrupted run.  Includes the corrupt-
+file contract (CheckpointCorruptError, ISSUE 15) and the v25 batched
+[V]-leading sweep checkpoints the service preempts through."""
 
 import numpy as np
+import pytest
 
 from graphite_tpu.config import load_config
+from graphite_tpu.engine.checkpoint import CheckpointCorruptError
 from graphite_tpu.engine.sim import Simulator
 from graphite_tpu.events import synth
 from graphite_tpu.params import SimParams
@@ -141,3 +145,127 @@ def test_resume_mid_window_fanout_identical(tmp_path):
         assert a == b, f"{f}: unbroken {a} != resumed {b}"
     for f, a in s_full.counters.items():
         assert np.array_equal(a, s_res.counters[f]), f
+
+
+# ------------------------------------------- corrupt files (ISSUE 15)
+
+def _solo_ckpt(tmp_path, name="ck.npz"):
+    cfg = load_config()
+    cfg.set("general/total_cores", 8)
+    params = SimParams.from_config(cfg)
+    trace = synth.gen_private_mem(8, accesses=5, working_set_kb=4)
+    sim = Simulator(params, trace)
+    ck = str(tmp_path / name)
+    sim.save_checkpoint(ck)
+    return params, trace, ck
+
+
+def test_truncated_checkpoint_raises_corrupt_error(tmp_path):
+    """A file torn under the writer (modeled as post-rename truncation)
+    must surface CheckpointCorruptError NAMING the path — not a generic
+    zipfile traceback — so the service's discard-and-rerun fallback can
+    key on it."""
+    params, trace, ck = _solo_ckpt(tmp_path)
+    size = int(__import__("os").path.getsize(ck))
+    with open(ck, "r+b") as f:
+        f.truncate(max(size // 3, 1))
+    sim = Simulator(params, trace)
+    with pytest.raises(CheckpointCorruptError, match="ck.npz"):
+        sim.restore_checkpoint(ck)
+
+
+def test_garbage_checkpoint_raises_corrupt_error(tmp_path):
+    params, trace, _ = _solo_ckpt(tmp_path)
+    bad = str(tmp_path / "bad.npz")
+    with open(bad, "wb") as f:
+        f.write(b"not a checkpoint at all")
+    with pytest.raises(CheckpointCorruptError, match="bad.npz"):
+        Simulator(params, trace).restore_checkpoint(bad)
+
+
+def test_missing_checkpoint_stays_file_not_found(tmp_path):
+    """An absent file is an operator error, not corruption: the service
+    treats the two differently (corrupt → rerun; missing → the journal
+    replay already dropped the resume record)."""
+    params, trace, _ = _solo_ckpt(tmp_path)
+    with pytest.raises(FileNotFoundError):
+        Simulator(params, trace).restore_checkpoint(
+            str(tmp_path / "nope.npz"))
+
+
+def test_checkpoint_save_is_atomic_no_tmp_left(tmp_path):
+    """The save path writes tmp + fsync + rename: after a successful
+    save the directory holds exactly the checkpoint, no orphan temp."""
+    import os
+    _, _, ck = _solo_ckpt(tmp_path, name="atomic.npz")
+    names = os.listdir(tmp_path)
+    assert "atomic.npz" in names
+    assert not [n for n in names if ".tmp" in n]
+
+
+# ------------------------------- v25: batched [V]-leading sweep states
+
+def test_sweep_checkpoint_mid_bucket_resume_identical(tmp_path):
+    """ACCEPTANCE (schema v25): a V=2 bucket checkpointed mid-flight
+    and restored into a FRESH SweepSimulator finishes with per-lane
+    clocks, quanta, and counters bit-identical to the unbroken batched
+    run — which is itself lane-identical to the solo runs.  The 100ns
+    barrier quantum stretches the tiny trace over several windows so
+    max_steps=2 genuinely splits mid-bucket."""
+    from graphite_tpu.sweep import SweepSimulator, build_variants
+
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    cfg.set("clock_skew_management/lax_barrier/quantum", 100)
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=1)
+    variants = [p for _, _, p in
+                build_variants(cfg, ["dram/latency=80,120"])]
+
+    full = SweepSimulator(variants, trace)
+    s_full = full.run()
+
+    half = SweepSimulator(variants, trace)
+    half.run(max_steps=2)
+    assert not all(s.done.all() for s in half.summaries()), \
+        "split landed after completion — the resume test has no bite"
+    ck = str(tmp_path / "bucket.ckpt.npz")
+    half.save_checkpoint(ck)
+
+    resumed = SweepSimulator(variants, trace)
+    resumed.restore_checkpoint(ck)
+    assert resumed.steps == half.steps
+    s_res = resumed.run()
+
+    for lane_full, lane_res, p in zip(s_full, s_res, variants):
+        np.testing.assert_array_equal(np.asarray(lane_full.clock),
+                                      np.asarray(lane_res.clock))
+        assert lane_full.quanta == lane_res.quanta
+        for k in lane_full.counters:
+            np.testing.assert_array_equal(lane_full.counters[k],
+                                          lane_res.counters[k], k)
+        solo = Simulator(p, trace).run()
+        np.testing.assert_array_equal(np.asarray(lane_res.clock),
+                                      np.asarray(solo.clock))
+
+
+def test_sweep_checkpoint_guards(tmp_path):
+    """Wrong-V loads and solo/sweep cross-loads fail loudly instead of
+    slicing garbage into lanes."""
+    from graphite_tpu.engine.checkpoint import (load_checkpoint,
+                                                load_sweep_checkpoint)
+    from graphite_tpu.sweep import SweepSimulator, build_variants
+
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=1)
+    variants = [p for _, _, p in
+                build_variants(cfg, ["dram/latency=80,120"])]
+    sim = SweepSimulator(variants, trace)
+    ck = str(tmp_path / "v2.ckpt.npz")
+    sim.save_checkpoint(ck)
+
+    with pytest.raises(ValueError, match="variants"):
+        load_sweep_checkpoint(ck, variants[:1],
+                              num_streams=trace.num_tiles)
+    with pytest.raises(ValueError, match="sweep"):
+        load_checkpoint(ck, variants[0])

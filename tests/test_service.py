@@ -1,0 +1,332 @@
+"""Fault-tolerant sweep service (graphite_tpu/sweep/service.py, ISSUE 15).
+
+The contract under test, pillar by pillar:
+
+  * **Crash-safe tickets** — every lifecycle transition is journaled
+    atomically; a restarted service replays the journal: DONE tickets
+    keep their summaries and are never re-run, in-flight (RUNNING)
+    tickets re-queue, preempted buckets resume from their checkpoint.
+  * **Poison-lane isolation** — a persistent per-lane fault sinks its
+    bucket; bounded retries + bisection isolate and QUARANTINE exactly
+    the poisoned ticket while every healthy lane is served
+    bit-identically to its solo run.  Padding lanes (copies of the last
+    real variant) never multiply a quarantine.
+  * **Preempt/resume** — a wall-clock-budget preemption checkpoints the
+    batched state at a window boundary (schema v25); a NEW service
+    process resumes it bit-identically.  A corrupt checkpoint is
+    discarded and the bucket re-runs from scratch.
+  * **Serve-from-cache** — a completed design point re-submitted
+    against the same results_db returns the stored summary with zero
+    buckets run and zero compiles.
+
+Faults come from graphite_tpu/testing/faults.py — the same harness the
+run_tests.sh kill-and-recover gate arms via GRAPHITE_FAULTS.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import load_config
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+from graphite_tpu.sweep import SweepService
+from graphite_tpu.sweep import batch as batchmod
+from graphite_tpu.sweep.service import (DONE, FAILED, QUARANTINED,
+                                        QUEUED, RUNNING)
+from graphite_tpu.testing import faults
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def trace():
+    return synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=1)
+
+
+def _cfg(**overrides):
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def _mk(trace, journal, cfg, **kw):
+    """Service with test-friendly defaults: zero backoff, recorded (not
+    real) sleeps."""
+    kw.setdefault("backoff_s", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return SweepService(trace, str(journal), cfg=cfg, **kw)
+
+
+def _solo(cfg, trace, overrides):
+    c = cfg.copy()
+    for k, v in overrides.items():
+        c.set(k, v)
+    p = SimParams.from_config(c, num_tiles=trace.num_tiles)
+    return Simulator(p, trace).run()
+
+
+def _solo_clock_ps(cfg, trace, overrides):
+    s = _solo(cfg, trace, overrides)
+    return np.asarray(s.clock).astype(np.int64).reshape(-1).tolist()
+
+
+# ----------------------------------------------- pillar 1: ticket journal
+
+def test_serve_journal_and_restart_never_reruns_done(trace, tmp_path):
+    """Happy path end to end, then the crash-safety core: a fresh
+    service over the same journal sees every ticket DONE with its
+    summary and serves without running (or compiling) anything."""
+    cfg = _cfg()
+    jd = tmp_path / "jd"
+    svc = _mk(trace, jd, cfg)
+    points = [{"dram/latency": v} for v in ("80", "100", "120")]
+    tids = [svc.submit(p) for p in points]
+    res = svc.serve()
+    assert [res[t].status for t in tids] == [DONE] * 3
+    assert not any(res[t].from_cache for t in tids)
+    assert svc.stats["buckets_run"] == 1       # one structural bucket
+    for t, p in zip(tids, points):
+        assert res[t].summary["clock_ps"] == _solo_clock_ps(cfg, trace, p)
+    # The journal is a directory of whole-or-absent records.
+    events = []
+    for n in sorted(os.listdir(jd)):
+        if n.startswith("rec-"):
+            with open(jd / n) as f:
+                events.append(json.load(f)["event"])
+    assert events.count("submit") == 3
+    assert events.count("done") == 3
+    assert "running" in events
+
+    before = batchmod.compile_count()
+    svc2 = _mk(trace, jd, cfg)
+    res2 = svc2.tickets()
+    assert [res2[t].status for t in tids] == [DONE] * 3
+    for t in tids:
+        assert res2[t].summary == res[t].summary
+    svc2.serve()
+    assert svc2.stats["buckets_run"] == 0
+    assert batchmod.compile_count() - before == 0
+
+
+def test_recovery_requeues_inflight_tickets(trace, tmp_path):
+    """A service that died mid-bucket left tickets journaled RUNNING
+    with no checkpoint: restart must re-queue (not drop, not complete)
+    them, then serve them normally."""
+    cfg = _cfg()
+    jd = tmp_path / "jd"
+    svc = _mk(trace, jd, cfg)
+    tids = [svc.submit({"dram/latency": v}) for v in ("90", "130")]
+    # Simulate the crash: mark the bucket RUNNING (journaled) and
+    # abandon the process before any terminal record lands.
+    svc._mark_running([svc.tickets()[t] for t in tids])
+
+    svc2 = _mk(trace, jd, cfg)
+    assert svc2.stats["recovered"] == 2
+    assert all(svc2.tickets()[t].status == QUEUED for t in tids)
+    res = svc2.serve()
+    assert all(res[t].status == DONE for t in tids)
+    assert res[tids[0]].summary["clock_ps"] == \
+        _solo_clock_ps(cfg, trace, {"dram/latency": "90"})
+
+
+def test_journal_rejects_wrong_trace(trace, tmp_path):
+    cfg = _cfg()
+    jd = tmp_path / "jd"
+    _mk(trace, jd, cfg)
+    other = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=2)
+    with pytest.raises(ValueError, match="different trace"):
+        _mk(other, jd, cfg)
+
+
+# --------------------------------------- pillar 2: poison-lane isolation
+
+def test_poison_bisection_v8_serves_seven_quarantines_one(trace, tmp_path):
+    """ACCEPTANCE: a V=8 bucket with one injected poison lane serves the
+    7 healthy tickets bit-identically to their solo runs and quarantines
+    exactly the poisoned one, error attached."""
+    cfg = _cfg()
+    svc = _mk(trace, tmp_path / "jd", cfg, max_retries=0)
+    lats = ["60", "70", "80", "90", "100", "110", "120", "130"]
+    tids = [svc.submit({"dram/latency": v}) for v in lats]
+    faults.arm("poison:dram/latency=120")
+    res = svc.serve()
+    bad = tids[lats.index("120")]
+    assert res[bad].status == QUARANTINED
+    assert "poison" in res[bad].error
+    assert svc.stats["quarantined"] == 1
+    for t, v in zip(tids, lats):
+        if t == bad:
+            continue
+        assert res[t].status == DONE
+        assert res[t].summary["clock_ps"] == \
+            _solo_clock_ps(cfg, trace, {"dram/latency": v})
+    # The quarantine is durable: a restart replays it, not the error run.
+    svc2 = _mk(trace, tmp_path / "jd", cfg)
+    assert svc2.tickets()[bad].status == QUARANTINED
+    assert svc2.tickets()[bad].error == res[bad].error
+    assert not svc2.open_tickets()
+
+
+def test_padding_lane_fault_quarantines_real_ticket_once(trace, tmp_path):
+    """V=3 pads to 4 with a COPY of the last variant; poisoning that
+    variant fails both its real lane and its padding clone.  Bisection
+    recurses over real tickets and re-pads, so the real ticket is
+    quarantined exactly once and the others complete."""
+    cfg = _cfg()
+    svc = _mk(trace, tmp_path / "jd", cfg, max_retries=0)
+    tids = [svc.submit({"dram/latency": v}) for v in ("100", "110", "120")]
+    faults.arm("poison:dram/latency=120")
+    res = svc.serve()
+    statuses = [res[t].status for t in tids]
+    assert statuses == [DONE, DONE, QUARANTINED]
+    assert svc.stats["quarantined"] == 1
+    assert sum(1 for t in res.values() if t.status == QUARANTINED) == 1
+
+
+def test_transient_fault_retries_with_backoff_then_succeeds(trace,
+                                                            tmp_path):
+    """A one-shot transient fault costs one backoff sleep and the
+    ticket still completes."""
+    cfg = _cfg()
+    sleeps = []
+    svc = _mk(trace, tmp_path / "jd", cfg, max_retries=2,
+              backoff_s=0.25, sleep=sleeps.append)
+    tid = svc.submit({"dram/latency": "100"})
+    faults.arm("raise_in_bucket:1")
+    res = svc.serve()
+    assert res[tid].status == DONE
+    assert svc.stats["retries"] == 1
+    assert sleeps == [0.25]
+    assert res[tid].summary["clock_ps"] == \
+        _solo_clock_ps(cfg, trace, {"dram/latency": "100"})
+
+
+def test_transient_exhausted_marks_failed_not_quarantined(trace,
+                                                          tmp_path):
+    """Retries exhausted on a TRANSIENT fault: the config is not proven
+    poisonous — FAILED (resubmit), not QUARANTINED (blacklist)."""
+    cfg = _cfg()
+    svc = _mk(trace, tmp_path / "jd", cfg, max_retries=0)
+    tid = svc.submit({"dram/latency": "100"})
+    faults.arm("raise_in_bucket:1")
+    res = svc.serve()
+    assert res[tid].status == FAILED
+    assert svc.stats["failed"] == 1
+    assert "raise_in_bucket" in res[tid].error
+
+
+def test_persistent_fault_backoff_is_exponential(trace, tmp_path):
+    """A persistent fault burns every retry with doubling delays before
+    the single-ticket bucket is quarantined."""
+    cfg = _cfg()
+    sleeps = []
+    svc = _mk(trace, tmp_path / "jd", cfg, max_retries=2,
+              backoff_s=0.1, sleep=sleeps.append)
+    tid = svc.submit({"dram/latency": "120"})
+    faults.arm("poison:dram/latency=120")
+    res = svc.serve()
+    assert res[tid].status == QUARANTINED
+    assert svc.stats["retries"] == 2
+    np.testing.assert_allclose(sleeps, [0.1, 0.2])
+
+
+# ------------------------------------------- pillar 3: preempt / resume
+
+def test_preempt_restart_resume_bit_identical(trace, tmp_path):
+    """ACCEPTANCE (schema v25 through the service): a budget preemption
+    checkpoints at a window boundary; a NEW service over the same
+    journal resumes the bucket and finishes bit-identically to an
+    uninterrupted solo run.  The 100ns barrier quantum stretches this
+    tiny trace over multiple windows so the preemption lands
+    mid-flight."""
+    cfg = _cfg(**{"clock_skew_management/lax_barrier/quantum": 100})
+    jd = tmp_path / "jd"
+    svc = _mk(trace, jd, cfg, poll_every=1)
+    tid = svc.submit({"dram/latency": "100"})
+    faults.arm("exhaust_budget:1")
+    res = svc.drain()
+    faults.disarm()
+    assert res[tid].status == RUNNING
+    assert svc.stats["preemptions"] == 1
+    assert len(svc._resumable) == 1
+    ckpt = svc._resumable[0]["checkpoint"]
+    assert os.path.exists(ckpt)
+
+    svc2 = _mk(trace, jd, cfg, poll_every=1)
+    assert len(svc2._resumable) == 1
+    res2 = svc2.serve()
+    assert res2[tid].status == DONE
+    assert res2[tid].summary["clock_ps"] == \
+        _solo_clock_ps(cfg, trace, {"dram/latency": "100"})
+    assert res2[tid].summary["quanta"] == \
+        _solo(cfg, trace, {"dram/latency": "100"}).quanta
+    assert not os.path.exists(ckpt), "consumed checkpoint not cleaned up"
+
+
+def test_corrupt_checkpoint_discarded_and_bucket_rerun(trace, tmp_path):
+    """A truncated (post-rename) checkpoint must not poison recovery:
+    the resume path surfaces CheckpointCorruptError, discards the file,
+    re-queues the bucket, and completes it from scratch."""
+    cfg = _cfg(**{"clock_skew_management/lax_barrier/quantum": 100})
+    jd = tmp_path / "jd"
+    svc = _mk(trace, jd, cfg, poll_every=1)
+    tid = svc.submit({"dram/latency": "110"})
+    faults.arm("exhaust_budget:1;truncate_checkpoint:1")
+    svc.drain()
+    faults.disarm()
+    assert svc._resumable
+
+    svc2 = _mk(trace, jd, cfg, poll_every=1)
+    res = svc2.serve()
+    assert res[tid].status == DONE
+    assert svc2.stats["checkpoints_discarded"] == 1
+    assert res[tid].summary["clock_ps"] == \
+        _solo_clock_ps(cfg, trace, {"dram/latency": "110"})
+
+
+# --------------------------------------------- pillar 4: cache serving
+
+def test_cache_serves_resubmission_with_zero_work(trace, tmp_path):
+    """ACCEPTANCE: re-submitting completed design points against the
+    same results_db serves every ticket from cache — zero compiles,
+    zero buckets run, summaries byte-equal — while a NEW design point
+    misses and simulates."""
+    cfg = _cfg()
+    db = str(tmp_path / "results.db")
+    points = [{"dram/latency": v} for v in ("80", "100", "120")]
+    svc = _mk(trace, tmp_path / "j1", cfg, db_path=db)
+    t1 = [svc.submit(p) for p in points]
+    r1 = svc.serve()
+    assert all(r1[t].status == DONE for t in t1)
+
+    before = batchmod.compile_count()
+    svc2 = _mk(trace, tmp_path / "j2", cfg, db_path=db)
+    t2 = [svc2.submit(p) for p in points]
+    r2 = svc2.serve()
+    assert batchmod.compile_count() - before == 0
+    assert svc2.stats["buckets_run"] == 0      # zero simulated windows
+    assert svc2.stats["cache_hits"] == 3
+    for a, b in zip(t1, t2):
+        assert r2[b].from_cache
+        assert r2[b].summary == r1[a].summary
+
+    # A design point the db has never seen must MISS and run.
+    svc3 = _mk(trace, tmp_path / "j3", cfg, db_path=db)
+    t3 = svc3.submit({"dram/latency": "95"})
+    r3 = svc3.serve()
+    assert r3[t3].status == DONE and not r3[t3].from_cache
+    assert svc3.stats["cache_hits"] == 0
+    assert svc3.stats["buckets_run"] == 1

@@ -266,3 +266,35 @@ def test_sweep_inv_ack_axis_bit_identical():
         clocks.append(lane.completion_time_ps)
     assert len(set(clocks)) > 1, \
         "inv_ack_combining_cycles axis never reached a completion time"
+
+
+def test_drain_mid_failure_keeps_completed_buckets():
+    """A drain that fails in its SECOND bucket must not discard the
+    first bucket's completed results: they are stashed and returned by
+    the retry drain, and the failed bucket stays queued (the drain()
+    docstring's promise — before ISSUE 15 a mid-drain raise dropped
+    every completed summary with the exception)."""
+    from graphite_tpu.testing import faults
+    from graphite_tpu.testing.faults import FaultInjected
+
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=1)
+    pa1 = _params(**{"general/total_cores": 4, "dram/latency": 80})
+    pa2 = _params(**{"general/total_cores": 4, "dram/latency": 100})
+    # Structurally distinct (block_events is a STRUCTURAL leaf): lands
+    # in its own, later bucket — and carries the poisoned value.
+    pb = _params(**{"general/total_cores": 4, "tpu/block_events": 4,
+                    "dram/latency": 120})
+    drv = SweepDriver(trace)
+    t1, t2, t3 = drv.submit(pa1), drv.submit(pa2), drv.submit(pb)
+    faults.arm("poison:dram/latency=120")
+    try:
+        with pytest.raises(FaultInjected):
+            drv.drain()
+    finally:
+        faults.disarm()
+    # Bucket A completed and left the queue; bucket B stays queued.
+    assert drv.pending() == 1
+    results = drv.drain()
+    assert sorted(results) == sorted([t1, t2, t3])
+    solo = Simulator(pa1, trace).run()
+    _assert_lane_equals_solo(results[t1], solo, "retained bucket lane 0")

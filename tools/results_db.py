@@ -75,8 +75,18 @@ REGRESSION_PCT = 20.0
 FF_DRIFT_BUDGET = 0.02
 
 
-def open_db(path: str) -> sqlite3.Connection:
-    db = sqlite3.connect(path)
+def open_db(path: str,
+            busy_timeout_ms: int = 5000) -> sqlite3.Connection:
+    """Open (creating as needed) with concurrency-safe pragmas: WAL
+    journaling so readers never block the writer, and a busy_timeout so
+    two service workers (or a worker plus a CLI reader) queue briefly
+    instead of throwing ``sqlite3.OperationalError: database is
+    locked``.  WAL is a no-op on media that can't support it (the
+    pragma reports the mode actually in effect; in-memory DBs stay in
+    'memory' mode) — the busy_timeout still applies."""
+    db = sqlite3.connect(path, timeout=busy_timeout_ms / 1000.0)
+    db.execute(f"PRAGMA busy_timeout = {int(busy_timeout_ms)}")
+    db.execute("PRAGMA journal_mode = WAL")
     db.executescript(_SCHEMA)
     return db
 

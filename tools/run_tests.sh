@@ -450,6 +450,83 @@ if [ $ff_rc -ne 0 ]; then
     fail=1
 fi
 
+# Kill-and-recover gate (ISSUE 15 CI satellite): a serving process is
+# SIGKILLed mid-bucket by the fault harness (GRAPHITE_FAULTS is
+# inherited through the environment — no cleanup, no atexit, the honest
+# crash); a restart with --resume must recover the journal, re-queue the
+# interrupted tickets, and produce per-lane summaries BIT-IDENTICAL to
+# an uninterrupted reference serve in a fresh journal.
+recover_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import json, os, shutil, signal, subprocess, sys, tempfile
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from graphite_tpu.events import synth
+
+tmp = tempfile.mkdtemp()
+trace_path = os.path.join(tmp, "t.npz")
+synth.gen_radix(2, keys_per_tile=16, radix=8, seed=1).save(trace_path)
+
+BASE = [sys.executable, "-c",
+        "from graphite_tpu.cli import main; raise SystemExit(main())",
+        # 100ns barrier quantum + 1-step windows: the tiny trace spans
+        # several window boundaries, so the 2nd-window SIGKILL lands
+        # genuinely mid-bucket.
+        "--general/total_cores=2",
+        "--clock_skew_management/lax_barrier/quantum=100",
+        "--service/poll_every=1",
+        "sweep", "--trace", trace_path, "--serve"]
+SWEEP = ["--sweep", "dram/latency=90,120"]
+
+def serve(journal, out, extra, env_faults=None):
+    env = dict(os.environ)
+    env.pop("GRAPHITE_FAULTS", None)
+    if env_faults:
+        env["GRAPHITE_FAULTS"] = env_faults
+    cmd = BASE + ["--journal", journal, "-o", out] + extra
+    return subprocess.run(cmd, env=env, cwd=os.getcwd(),
+                          capture_output=True, text=True, timeout=900)
+
+# Reference leg: uninterrupted serve in its own journal.
+ref_out = os.path.join(tmp, "ref.json")
+r = serve(os.path.join(tmp, "jref"), ref_out, SWEEP)
+assert r.returncode == 0, r.stderr[-2000:]
+ref = json.load(open(ref_out))["detail"]
+assert ref and all(v["status"] == "done" for v in ref.values())
+
+# Kill leg: the armed harness SIGKILLs the process at the 2nd window.
+jkill = os.path.join(tmp, "jkill")
+kill_out = os.path.join(tmp, "kill.json")
+k = serve(jkill, kill_out, SWEEP, env_faults="sigkill_in_bucket:2")
+assert k.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL), \
+    f"expected SIGKILL death, rc={k.returncode}\n{k.stderr[-2000:]}"
+assert not os.path.exists(kill_out), \
+    "killed leg must die before emitting results"
+
+# Recovery leg: restart over the same journal (--resume re-queues the
+# in-flight tickets; no --sweep — the journal is the work source).
+rec_out = os.path.join(tmp, "rec.json")
+r2 = serve(jkill, rec_out, ["--resume"])
+assert r2.returncode == 0, r2.stderr[-2000:]
+rec = json.load(open(rec_out))
+assert rec["stats"]["recovered"] >= 1, rec["stats"]
+det = rec["detail"]
+assert set(det) == set(ref)
+for label, row in ref.items():
+    assert det[label]["status"] == "done", (label, det[label])
+    assert det[label]["clock_ps"] == row["clock_ps"], \
+        f"{label}: recovered lane diverged from the uninterrupted serve"
+    assert det[label]["quanta"] == row["quanta"], label
+shutil.rmtree(tmp)
+print(f"KILL-AND-RECOVER SMOKE OK ({len(det)} tickets bit-identical "
+      f"after SIGKILL mid-bucket; {rec['stats']['recovered']} requeued)")
+PYEOF
+)
+recover_rc=$?
+echo "$recover_out" | tail -3
+if [ $recover_rc -ne 0 ]; then
+    echo "KILL-AND-RECOVER GATE FAILED"
+    fail=1
+fi
+
 if [ $fail -eq 0 ]; then
     echo "ALL MODULES PASSED"
 else
