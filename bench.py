@@ -441,6 +441,11 @@ def _service_row():
         t1 = time.perf_counter()
         svc2.serve()
         cache_s = time.perf_counter() - t1
+        # Serving-latency percentiles from the SIMULATED pass (svc):
+        # first-result latency is submit -> streamed lane-done poll,
+        # so it reflects the per-lane streaming path, not cache reads.
+        lat = svc.latency_stats()
+        lat2 = svc2.latency_stats()
         return {
             "kind": "completed" if all_done else "throughput_probe",
             "num_tiles": T,
@@ -453,6 +458,13 @@ def _service_row():
             "served_from_cache": bool(
                 svc2.stats["cache_hits"] == V
                 and svc2.stats["buckets_run"] == 0),
+            "p50_first_result_s": (
+                round(lat["p50_first_result_s"], 4)
+                if lat["p50_first_result_s"] is not None else None),
+            "p99_first_result_s": (
+                round(lat["p99_first_result_s"], 4)
+                if lat["p99_first_result_s"] is not None else None),
+            "cache_hit_ratio": lat2["cache_hit_ratio"],
             "all_done": all_done,
             "workload": "radix8 x 4 variants via fault-tolerant service "
                         "+ results_db cache re-serve",

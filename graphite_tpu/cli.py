@@ -68,6 +68,24 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="results_db sqlite path: completed tickets are "
                          "stored and identical re-submissions are served "
                          "from cache without simulating")
+    sw.add_argument("--metrics-path", default=None, metavar="PATH",
+                    help="(--serve only) enable the obs metrics "
+                         "registry and write its Prometheus text "
+                         "exposition here, atomically after every "
+                         "drain and once more on exit (ticket_latency_s"
+                         " / first_result_latency_s histograms, "
+                         "cache_hit_ratio, tickets_in_state, ...)")
+
+    st = sub.add_parser(
+        "status", help="summarize a sweep-service journal: per-state "
+                       "counts and a per-ticket table (works on a live "
+                       "service's journal — records are atomic)")
+    st.add_argument("-c", "--config", default=None)
+    st.add_argument("--journal", required=True, metavar="DIR",
+                    help="service journal directory to fold")
+    st.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw journal_status dict as JSON "
+                         "instead of the table")
 
     par = sub.add_parser("params", help="print derived simulation parameters")
     par.add_argument("-c", "--config", default=None)
@@ -109,6 +127,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.command == "sweep":
         return _sweep_command(cfg, args)
+
+    if args.command == "status":
+        return _status_command(args)
 
     return 2
 
@@ -198,11 +219,17 @@ def _serve_command(cfg, args) -> int:
               "--resume)", file=sys.stderr)
         return 2
     trace = Trace.load(args.trace)
-    svc = SweepService(trace, journal, cfg=cfg, db_path=args.db)
+    svc = SweepService(trace, journal, cfg=cfg, db_path=args.db,
+                       metrics_path=args.metrics_path)
     for overrides in parse_sweep_spec(args.sweep) if args.sweep else []:
         svc.submit(overrides)
     t0 = time.perf_counter()
-    tickets = svc.serve()
+    try:
+        tickets = svc.serve()
+    finally:
+        # Exposition on exit even when serve() raises: the scrape file
+        # reflects whatever the process actually got through.
+        svc.write_metrics()
     host_s = time.perf_counter() - t0
     detail = {}
     for t in sorted(tickets.values(), key=lambda t: t.ticket):
@@ -220,15 +247,24 @@ def _serve_command(cfg, args) -> int:
         print(f"ticket {t.ticket} [{t.label}]: {t.status}"
               f"{' (cache)' if t.from_cache else ''}"
               f"{' — ' + t.error if t.error else ''}")
+    served = sum(1 for t in tickets.values() if t.status == "done")
+    lat = svc.latency_stats()
     out = {
         "metric": "sweep_service",
         "workload": args.trace,
         "tickets": len(tickets),
+        "variants": served,
         "host_seconds": round(host_s, 3),
+        "variants_per_sec": round(served / max(host_s, 1e-9), 3),
+        "p50_first_result_s": lat["p50_first_result_s"],
+        "p99_first_result_s": lat["p99_first_result_s"],
+        "cache_hit_ratio": lat["cache_hit_ratio"],
         "compiles": svc.compiles_observed,
         "stats": svc.stats,
         "detail": detail,
     }
+    if args.metrics_path:
+        out["metrics_path"] = args.metrics_path
     line = json.dumps(out)
     if args.output:
         with open(args.output, "w") as f:
@@ -237,6 +273,43 @@ def _serve_command(cfg, args) -> int:
     quarantined = sum(1 for t in tickets.values()
                       if t.status in ("quarantined", "failed"))
     return 0 if quarantined == 0 else 3
+
+
+def _status_command(args) -> int:
+    """status --journal DIR: fold the journal into a per-state /
+    per-ticket table without loading a trace or building params."""
+    import os
+
+    from graphite_tpu.sweep.service import STATES, journal_status
+
+    if not os.path.isdir(args.journal):
+        print(f"status: no journal directory at {args.journal!r}",
+              file=sys.stderr)
+        return 2
+    st = journal_status(args.journal)
+    if args.as_json:
+        print(json.dumps(st))
+        return 0
+    counts = " ".join(f"{s}={st['counts'][s]}" for s in STATES)
+    print(f"journal {st['journal_dir']}: {len(st['tickets'])} tickets "
+          f"({counts})")
+    for k in ("p50_first_result_s", "p99_first_result_s",
+              "p50_ticket_latency_s", "p99_ticket_latency_s"):
+        if st[k] is not None:
+            print(f"  {k} = {st[k]:.3f}")
+    for r in st["tickets"]:
+        tm = r["times"]
+        when = ""
+        if "submit" in tm and "done" in tm:
+            when = f"  ({tm['done'] - tm['submit']:.3f}s)"
+        elif "submit" in tm and "first_result" in tm:
+            when = (f"  (first result after "
+                    f"{tm['first_result'] - tm['submit']:.3f}s)")
+        cache = " (cache)" if r["from_cache"] else ""
+        err = f" — {r['error']}" if r["error"] else ""
+        print(f"  ticket {r['ticket']:4d} [{r['label']}]: "
+              f"{r['status']}{cache}{when}{err}")
+    return 0
 
 
 def _run_command(cfg, args, telemetry_dir: Optional[str]) -> int:

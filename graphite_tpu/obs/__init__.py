@@ -18,12 +18,21 @@ observability of the *simulator itself*:
   * ``export`` — a machine-readable RunReport JSON (superset of the text
     summary; consumed by bench.py / tools/results_db.py) and a Chrome
     trace-event / Perfetto JSON merging host wall-clock span tracks with
-    per-tile simulated-time tracks.
+    per-tile simulated-time tracks (plus, when given tickets, the sweep
+    service's per-ticket lifecycle track on the same wall-clock axis).
+  * ``registry`` — process-wide SERVICE metrics (counters, gauges,
+    fixed-bucket histograms with labels): the sweep service's ticket
+    latencies, cache-hit ratio, and per-state gauges, rendered as a
+    Prometheus text exposition + JSON snapshot.  Same null-path
+    discipline as spans: one attribute check when disabled.
 """
 
 from graphite_tpu.obs.spans import (  # noqa: F401
     SpanTracer, enable_tracing, get_tracer, span, tracing_enabled)
 from graphite_tpu.obs.metrics import TEL_SERIES  # noqa: F401
+from graphite_tpu.obs.registry import (  # noqa: F401
+    MetricsRegistry, enable_metrics, get_registry, metrics_enabled,
+    parse_exposition, render_exposition, write_exposition)
 from graphite_tpu.obs.export import (  # noqa: F401
-    RUN_REPORT_SCHEMA, build_run_report, chrome_trace,
+    RUN_REPORT_SCHEMA, build_run_report, chrome_trace, ticket_events,
     write_telemetry_dir)

@@ -32,6 +32,7 @@ RUN_REPORT_SCHEMA = "graphite_tpu/run_report@1"
 
 HOST_PID = 1        # host driver (wall clock) process track
 DEVICE_PID = 2      # simulated device time process track
+SERVICE_PID = 3     # sweep-service ticket lifecycle (wall clock) track
 
 # JSON-embedded per-tile matrices are capped (flagged, never silent):
 # a 1024-tile x 1024-sample cursor matrix would dominate the report.
@@ -162,15 +163,72 @@ def _device_events(summary) -> List[Dict[str, Any]]:
     return ev
 
 
-def chrome_trace(summary=None, tracer=None) -> Dict[str, Any]:
+# Ticket lifecycle phases rendered as slices, in timeline order.  Each
+# entry is (slice name, start mark, set of end marks — first present
+# wins).  Marks are Ticket.marks keys (perf_counter seconds), recorded
+# by sweep/service.py on live transitions.
+_TICKET_PHASES = (
+    ("queued", "submit", ("running", "first_result", "done")),
+    ("running", "running", ("first_result", "done")),
+    ("streaming", "first_result", ("done",)),
+)
+
+
+def ticket_events(tickets, epoch_ns: Optional[int] = None
+                  ) -> List[Dict[str, Any]]:
+    """Chrome-trace slices for sweep-service ticket lifecycles: one tid
+    per ticket on the SERVICE_PID track, phases queued/running/streaming
+    as X slices, terminal status in args.  ``tickets`` is any iterable
+    of sweep.service.Ticket; only tickets with live (this-process) marks
+    render — replayed tickets carry wall-clock times from a dead
+    process, which share no timeline with the current tracer.  With
+    ``epoch_ns`` from a SpanTracer, ticket slices land on the SAME
+    wall-clock axis as the host spans (both derive from perf_counter),
+    so a drain renders as one timeline."""
+    items = [t for t in tickets if getattr(t, "marks", None)]
+    if not items:
+        return []
+    if epoch_ns is None:
+        epoch_ns = int(min(min(t.marks.values()) for t in items) * 1e9)
+    ev: List[Dict[str, Any]] = [{
+        "ph": "M", "name": "process_name", "pid": SERVICE_PID, "tid": 0,
+        "args": {"name": "sweep service (wall clock)"}}]
+    for t in sorted(items, key=lambda t: t.ticket):
+        ev.append({"ph": "M", "name": "thread_name", "pid": SERVICE_PID,
+                   "tid": t.ticket,
+                   "args": {"name": f"ticket {t.ticket} [{t.label}]"}})
+        for phase, start, ends in _TICKET_PHASES:
+            if start not in t.marks:
+                continue
+            end = next((t.marks[e] for e in ends if e in t.marks), None)
+            if end is None or end < t.marks[start]:
+                continue
+            ev.append({
+                "name": phase, "cat": "ticket", "ph": "X",
+                "ts": (t.marks[start] * 1e9 - epoch_ns) / 1e3,
+                "dur": (end - t.marks[start]) * 1e6,
+                "pid": SERVICE_PID, "tid": t.ticket,
+                "args": {"ticket": t.ticket, "label": t.label,
+                         "status": t.status,
+                         "from_cache": bool(t.from_cache)}})
+    return ev
+
+
+def chrome_trace(summary=None, tracer=None, tickets=None
+                 ) -> Dict[str, Any]:
     """Build the Chrome trace-event JSON dict (loadable by Perfetto /
     chrome://tracing): ``traceEvents`` of X/C/M phase events with
-    ts (microseconds), pid, tid."""
+    ts (microseconds), pid, tid.  ``tickets`` adds the sweep-service
+    lifecycle track beside the host spans (same wall-clock axis)."""
     events: List[Dict[str, Any]] = []
     if tracer is not None and tracer.events:
         events.extend(_host_events(tracer))
     if summary is not None:
         events.extend(_device_events(summary))
+    if tickets is not None:
+        events.extend(ticket_events(
+            tickets,
+            epoch_ns=tracer.epoch_ns if tracer is not None else None))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ns",

@@ -45,7 +45,9 @@ _CUMULATIVE = ("events_retired", "instructions", "quanta", "rounds_window",
 def derive_rates(series: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     """Per-window rates from the cumulative series (length n-1 each):
     the engine-health numbers PROFILE.md derives by hand — events retired
-    per round, rounds per quantum, quanta per sample window."""
+    per round, rounds per quantum, quanta per sample window — plus the
+    instantaneous clock skew (clock_max − clock_min, length n: the
+    lax-barrier slack the fast-forward span budget trades against)."""
     out: Dict[str, np.ndarray] = {}
     for name in _CUMULATIVE:
         if name in series and len(series[name]) >= 2:
@@ -53,6 +55,13 @@ def derive_rates(series: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     if "d_events_retired" in out and "d_rounds_window" in out:
         rounds = out["d_rounds_window"] + out.get(
             "d_rounds_complex", np.zeros_like(out["d_rounds_window"]))
-        out["events_per_round"] = out["d_events_retired"] \
-            / np.maximum(rounds, 1)
+        # A sample window with ZERO rounds (an idle window between two
+        # samples, or a fast-forwarded span) must read 0 events/round,
+        # not d_events/1 — guard the division explicitly.
+        out["events_per_round"] = np.where(
+            rounds > 0,
+            out["d_events_retired"] / np.maximum(rounds, 1), 0.0)
+    if "clock_max_ps" in series and "clock_min_ps" in series:
+        out["clock_skew_ps"] = (np.asarray(series["clock_max_ps"])
+                                - np.asarray(series["clock_min_ps"]))
     return out

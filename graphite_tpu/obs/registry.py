@@ -1,0 +1,435 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+obs/spans.py profiles ONE run's host path and obs/metrics.py samples ONE
+run's device gauges; a *serving* process (sweep/service.py) needs
+process-lifetime aggregates instead — tickets served, cache hits,
+latency distributions — rendered in the two formats a fleet scrapes:
+
+  * ``render_exposition`` — Prometheus text exposition (``# HELP`` /
+    ``# TYPE`` / ``name{label="v"} value`` lines, histograms as
+    ``_bucket{le=...}``/``_sum``/``_count`` families), written
+    atomically by the service each drain (``write_exposition``);
+  * ``snapshot`` — a JSON-able dict for bench rows and tests.
+
+Disabled-path discipline mirrors spans.py: every mutation
+(``inc``/``set``/``observe``) starts with one attribute check on the
+owning registry and returns — no allocation, no lock, no clock read —
+so instrumentation stays in the serving path unconditionally.  The
+registry never touches simulated time: it is host-side bookkeeping
+only, and metrics-off runs are bit-identical by construction.
+
+Histograms use FIXED bucket upper bounds chosen at creation (defaults
+sized for ticket latencies: 1 ms .. 5 min).  ``percentile`` linearly
+interpolates inside the bucket that crosses the rank — the standard
+Prometheus ``histogram_quantile`` estimate, hand-checkable in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "get_registry", "enable_metrics", "metrics_enabled",
+           "render_exposition", "parse_exposition", "write_exposition",
+           "DEFAULT_LATENCY_BUCKETS"]
+
+# Ticket/first-result latency bucket bounds (seconds).  Serving latencies
+# straddle "cache hit" (sub-ms) to "compile + long bucket" (minutes).
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+def _label_key(labelnames: Tuple[str, ...],
+               labels: Dict[str, str]) -> Tuple[str, ...]:
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} != declared {sorted(labelnames)}")
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+class _Metric:
+    """Shared shape: a name, help text, declared label names, and one
+    value-cell per observed label combination."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str,
+                 help_text: str, labelnames: Tuple[str, ...]):
+        self._registry = registry
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return _label_key(self.labelnames, labels)
+
+
+class Counter(_Metric):
+    """Monotone float counter (per label set)."""
+
+    kind = "counter"
+
+    def __init__(self, registry, name, help_text, labelnames):
+        super().__init__(registry, name, help_text, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        if amount < 0:
+            raise ValueError("counters only go up")
+        k = self._key(labels)
+        self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def samples(self):
+        return [(self.name, dict(zip(self.labelnames, k)), v)
+                for k, v in sorted(self._values.items())]
+
+
+class Gauge(_Metric):
+    """Set-to-current-value gauge (per label set)."""
+
+    kind = "gauge"
+
+    def __init__(self, registry, name, help_text, labelnames):
+        super().__init__(registry, name, help_text, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        self._values[self._key(labels)] = float(value)
+
+    def add(self, delta: float, **labels) -> None:
+        """Delta update (may be negative).  For gauges several writers
+        share — e.g. tickets_in_state fed by more than one SweepService
+        in one process — absolute set() would make the last writer
+        clobber the others; deltas compose."""
+        if not self._registry.enabled:
+            return
+        key = self._key(labels)
+        self._values[key] = self._values.get(key, 0.0) + float(delta)
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def samples(self):
+        return [(self.name, dict(zip(self.labelnames, k)), v)
+                for k, v in sorted(self._values.items())]
+
+
+class _HistCell:
+    __slots__ = ("bucket_counts", "sum", "count")
+
+    def __init__(self, nbuckets: int):
+        self.bucket_counts = [0] * (nbuckets + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram: cumulative-at-render, per-bucket counts
+    internally.  ``bounds`` are finite upper bounds in increasing order;
+    an implicit +Inf bucket catches the overflow."""
+
+    kind = "histogram"
+
+    def __init__(self, registry, name, help_text, labelnames,
+                 bounds: Iterable[float] = DEFAULT_LATENCY_BUCKETS):
+        super().__init__(registry, name, help_text, labelnames)
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError("histogram bounds must strictly increase")
+        self._cells: Dict[Tuple[str, ...], _HistCell] = {}
+
+    def _cell(self, labels: Dict[str, str]) -> _HistCell:
+        k = self._key(labels)
+        cell = self._cells.get(k)
+        if cell is None:
+            cell = self._cells[k] = _HistCell(len(self.bounds))
+        return cell
+
+    def observe(self, value: float, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        value = float(value)
+        cell = self._cell(labels)
+        # First bucket whose upper bound holds the value; +Inf otherwise.
+        idx = len(self.bounds)
+        for i, b in enumerate(self.bounds):
+            if value <= b:
+                idx = i
+                break
+        cell.bucket_counts[idx] += 1
+        cell.sum += value
+        cell.count += 1
+
+    def count(self, **labels) -> int:
+        cell = self._cells.get(self._key(labels))
+        return cell.count if cell is not None else 0
+
+    def total(self, **labels) -> float:
+        cell = self._cells.get(self._key(labels))
+        return cell.sum if cell is not None else 0.0
+
+    def percentile(self, p: float, **labels) -> Optional[float]:
+        """Estimate the p-quantile (p in [0, 1]) by linear interpolation
+        inside the bucket that crosses rank p*count; None when empty.
+        Overflow (+Inf bucket) clamps to the largest finite bound — the
+        estimate degrades gracefully instead of inventing a value."""
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"quantile {p} outside [0, 1]")
+        cell = self._cells.get(self._key(labels))
+        if cell is None or cell.count == 0:
+            return None
+        target = p * cell.count
+        cum = 0.0
+        lo = 0.0
+        for i, b in enumerate(self.bounds):
+            n = cell.bucket_counts[i]
+            if n and cum + n >= target:
+                frac = (target - cum) / n
+                return lo + (b - lo) * max(frac, 0.0)
+            cum += n
+            lo = b
+        return self.bounds[-1] if self.bounds else None
+
+    def samples(self):
+        """Exposition-shaped samples: cumulative ``_bucket`` rows per
+        ``le`` bound (+Inf last), then ``_sum`` and ``_count``."""
+        out = []
+        for k, cell in sorted(self._cells.items()):
+            base = dict(zip(self.labelnames, k))
+            cum = 0
+            for b, n in zip(self.bounds, cell.bucket_counts):
+                cum += n
+                out.append((self.name + "_bucket",
+                            {**base, "le": _fmt_bound(b)}, float(cum)))
+            out.append((self.name + "_bucket",
+                        {**base, "le": "+Inf"}, float(cell.count)))
+            out.append((self.name + "_sum", dict(base), cell.sum))
+            out.append((self.name + "_count", dict(base),
+                        float(cell.count)))
+        return out
+
+
+def _fmt_bound(b: float) -> str:
+    return repr(b) if b != int(b) else str(int(b))
+
+
+class MetricsRegistry:
+    """Named metric directory.  ``counter``/``gauge``/``histogram`` are
+    get-or-create (re-registration with a different kind or label set is
+    an error — silent aliasing would merge unrelated series)."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help_text: str,
+             labels: Tuple[str, ...], **kw) -> _Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(self, name, help_text,
+                                          tuple(labels), **kw)
+            return m
+        if type(m) is not cls or m.labelnames != tuple(labels):
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind} with "
+                f"labels {m.labelnames}")
+        return m
+
+    def counter(self, name: str, help_text: str = "",
+                labels: Tuple[str, ...] = ()) -> Counter:
+        return self._get(Counter, name, help_text, labels)
+
+    def gauge(self, name: str, help_text: str = "",
+              labels: Tuple[str, ...] = ()) -> Gauge:
+        return self._get(Gauge, name, help_text, labels)
+
+    def histogram(self, name: str, help_text: str = "",
+                  labels: Tuple[str, ...] = (),
+                  bounds: Iterable[float] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        return self._get(Histogram, name, help_text, labels,
+                         bounds=bounds)
+
+    def metrics(self) -> List[_Metric]:
+        return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def snapshot(self) -> Dict[str, list]:
+        """{name: [[labels, value], ...]} over every sample — plain JSON
+        types (histograms expand into their _bucket/_sum/_count rows)."""
+        out: Dict[str, list] = {}
+        for m in self.metrics():
+            for name, labels, value in m.samples():
+                out.setdefault(name, []).append([labels, value])
+        return out
+
+    def reset(self) -> None:
+        self._metrics.clear()
+
+
+# One process-wide registry, mirroring spans._TRACER: serving-path call
+# sites are one import away and a scrape sees the whole process.
+_REGISTRY = MetricsRegistry(enabled=False)
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def metrics_enabled() -> bool:
+    return _REGISTRY.enabled
+
+
+def enable_metrics(enabled: bool = True,
+                   reset: bool = False) -> MetricsRegistry:
+    """Switch the global registry on/off.  Unlike span tracing, values
+    are process-cumulative by design, so ``reset`` defaults False."""
+    if reset:
+        _REGISTRY.reset()
+    _REGISTRY.enabled = enabled
+    return _REGISTRY
+
+
+# ------------------------------------------------------------ exposition
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def render_exposition(registry: Optional[MetricsRegistry] = None) -> str:
+    """Prometheus text exposition format (version 0.0.4): HELP/TYPE
+    headers per family, one ``name{labels} value`` line per sample."""
+    registry = registry if registry is not None else _REGISTRY
+    lines: List[str] = []
+    for m in registry.metrics():
+        lines.append(f"# HELP {m.name} {_escape(m.help)}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        for name, labels, value in m.samples():
+            if labels:
+                body = ",".join(f'{k}="{_escape(str(v))}"'
+                                for k, v in labels.items())
+                lines.append(f"{name}{{{body}}} {_fmt_value(value)}")
+            else:
+                lines.append(f"{name} {_fmt_value(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_exposition(text: str
+                     ) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Inverse of render_exposition (for the formats this module emits):
+    {sample_name: [(labels, value), ...]}.  Raises ValueError on a
+    malformed line, so CI can assert the exposition PARSES."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, labels, rest = _parse_sample_head(line, lineno)
+        rest = rest.strip()
+        if not rest:
+            raise ValueError(f"line {lineno}: missing value: {line!r}")
+        try:
+            value = float(rest.split()[0])
+        except ValueError as e:
+            raise ValueError(f"line {lineno}: bad value {rest!r}") from e
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def _parse_sample_head(line: str, lineno: int):
+    brace = line.find("{")
+    if brace < 0:
+        name, _, rest = line.partition(" ")
+        if not name:
+            raise ValueError(f"line {lineno}: no metric name: {line!r}")
+        return name, {}, rest
+    name = line[:brace]
+    end = _find_close_brace(line, brace, lineno)
+    labels = _parse_labels(line[brace + 1:end], lineno)
+    return name, labels, line[end + 1:]
+
+
+def _find_close_brace(line: str, brace: int, lineno: int) -> int:
+    in_quote = False
+    i = brace + 1
+    while i < len(line):
+        c = line[i]
+        if in_quote:
+            if c == "\\":
+                i += 1
+            elif c == '"':
+                in_quote = False
+        elif c == '"':
+            in_quote = True
+        elif c == "}":
+            return i
+        i += 1
+    raise ValueError(f"line {lineno}: unterminated label set: {line!r}")
+
+
+def _parse_labels(body: str, lineno: int) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    i = 0
+    while i < len(body):
+        eq = body.find("=", i)
+        if eq < 0:
+            if body[i:].strip(", "):
+                raise ValueError(
+                    f"line {lineno}: trailing label junk {body[i:]!r}")
+            break
+        key = body[i:eq].strip().lstrip(",").strip()
+        if body[eq + 1:eq + 2] != '"':
+            raise ValueError(f"line {lineno}: unquoted label value")
+        j = eq + 2
+        val: List[str] = []
+        while j < len(body) and body[j] != '"':
+            if body[j] == "\\" and j + 1 < len(body):
+                nxt = body[j + 1]
+                val.append({"n": "\n", "\\": "\\", '"': '"'}
+                           .get(nxt, "\\" + nxt))
+                j += 2
+            else:
+                val.append(body[j])
+                j += 1
+        if j >= len(body):
+            raise ValueError(f"line {lineno}: unterminated label value")
+        labels[key] = "".join(val)
+        i = j + 1
+    return labels
+
+
+def write_exposition(path: str,
+                     registry: Optional[MetricsRegistry] = None) -> None:
+    """Atomically (tmp + rename) write the exposition to ``path`` — a
+    scraper or `cat` mid-drain never sees a torn file."""
+    import os
+    import tempfile
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.prom")
+    pending = tmp
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(render_exposition(registry))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        pending = None
+    finally:
+        if pending is not None:
+            try:
+                os.unlink(pending)
+            except OSError:
+                pass

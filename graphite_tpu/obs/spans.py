@@ -65,7 +65,7 @@ class _LiveSpan:
         t1 = time.perf_counter_ns()
         tr = self._tracer
         tr._depth -= 1
-        tr.events.append(SpanEvent(
+        tr._record(SpanEvent(
             name=self._name, t0_ns=self._t0 - tr.epoch_ns,
             dur_ns=t1 - self._t0, depth=tr._depth, args=self._args))
         return False
@@ -77,12 +77,24 @@ class SpanTracer:
     Events are appended at span EXIT (a parent therefore follows its
     children in ``events``); ``t0_ns`` is relative to the tracer's epoch
     so runs serialize with stable small timestamps.
+
+    ``events`` is BOUNDED (``max_events``, default 64k): an always-on
+    tracer inside a long-lived serving process must not grow without
+    limit.  Past the cap, new spans are counted in ``dropped`` (and the
+    process-wide ``spans_dropped_total`` registry counter) instead of
+    recorded — the oldest spans win because they hold the compile story
+    a drain's timeline is usually read for.
     """
 
-    def __init__(self, enabled: bool = False):
+    DEFAULT_MAX_EVENTS = 65536
+
+    def __init__(self, enabled: bool = False,
+                 max_events: int = DEFAULT_MAX_EVENTS):
         self.enabled = enabled
         self.epoch_ns = time.perf_counter_ns()
         self.events: List[SpanEvent] = []
+        self.max_events = max_events
+        self.dropped = 0
         self._depth = 0
 
     def span(self, name: str, **args):
@@ -90,8 +102,19 @@ class SpanTracer:
             return _NULL_SPAN
         return _LiveSpan(self, name, args or None)
 
+    def _record(self, event: SpanEvent) -> None:
+        if len(self.events) >= self.max_events:
+            self.dropped += 1
+            from graphite_tpu.obs.registry import get_registry
+            get_registry().counter(
+                "spans_dropped_total",
+                "spans discarded past SpanTracer.max_events").inc()
+            return
+        self.events.append(event)
+
     def clear(self) -> None:
         self.events = []
+        self.dropped = 0
         self._depth = 0
 
     def mark(self) -> int:

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -159,6 +159,9 @@ class SweepSimulator:
         # lane finished: the batch stopped at a window boundary and the
         # state is checkpointable/resumable bit-identically.
         self.preempted = False
+        # {lane: steps} — the window step at which each lane's done flag
+        # was first observed by run()'s poll (streaming order evidence).
+        self.lane_done_step: Dict[int, int] = {}
 
     @property
     def num_variants(self) -> int:
@@ -166,7 +169,8 @@ class SweepSimulator:
 
     def run(self, max_steps: Optional[int] = None,
             poll_every: int = 8,
-            budget_s: Optional[float] = None) -> List[SimSummary]:
+            budget_s: Optional[float] = None,
+            on_lane_done=None) -> List[SimSummary]:
         """Run windows until EVERY variant is done (or max_steps); one
         SimSummary per variant, in submission order.
 
@@ -176,7 +180,18 @@ class SweepSimulator:
         restore_checkpoint + run() continues bit-identically (the
         megarun quantum budget is relative to the entry state, and the
         engine is deterministic quantum-by-quantum, so where the
-        windows are cut cannot change any lane's math)."""
+        windows are cut cannot change any lane's math).
+
+        ``on_lane_done(lane, summary)`` streams per-lane results: it
+        fires at the first poll that finds lane ``lane`` done — possibly
+        many windows before the slowest lane finishes — with that lane's
+        FINAL SimSummary (a done lane's state is frozen bit-exactly by
+        the masked loop, so the summary streamed early equals the one
+        summaries() returns at the end, except host_seconds, which reads
+        the wall clock at delivery).  Callback exceptions propagate (the
+        lane poll is host code); keep handlers cheap — the batch stalls
+        while they run.  ``lane_done_step`` records, per lane, the
+        window step count at which its done flag was first observed."""
         from graphite_tpu.log import get_logger
         from graphite_tpu.obs import span
         lg = get_logger("sweep")
@@ -186,11 +201,13 @@ class SweepSimulator:
         if faults.armed():
             faults.maybe_raise_poison(self.variants)
         self.preempted = False
+        self.lane_done_step: Dict[int, int] = {}
         t0 = time.perf_counter()
         qps = base.quanta_per_step
         last_progress = None
         first_dispatch = True
         quanta_v = np.zeros(self.num_variants, dtype=np.int64)
+        streamed = np.zeros(self.num_variants, dtype=bool)
         while True:
             window = poll_every if max_steps is None \
                 else max(min(poll_every, max_steps - self.steps), 0)
@@ -213,6 +230,15 @@ class SweepSimulator:
             # The device loop runs to the slowest variant; window
             # accounting follows that lane.
             self.steps = -(-int(np.max(quanta_v)) // qps)
+            newly_done = np.nonzero(done_v & ~streamed)[0]
+            for lane in newly_done:
+                self.lane_done_step[int(lane)] = self.steps
+                if on_lane_done is not None:
+                    on_lane_done(int(lane), SimSummary(
+                        self.variants[int(lane)],
+                        _lane(self.bstate, int(lane)),
+                        time.perf_counter() - t0, self.steps))
+            streamed |= done_v
             if bool(done_v.all()):
                 break
             if max_steps is not None and self.steps >= max_steps:
@@ -224,16 +250,32 @@ class SweepSimulator:
                 break
             progress = (int(cursor_sum), int(clock_sum))
             if progress == last_progress:
-                stuck = [i for i, d in enumerate(done_v) if not d]
                 raise DeadlockError(
                     f"no progress after {self.steps} steps "
-                    f"(undone variants: {stuck})")
+                    + self._stuck_report(done_v, quanta_v))
             last_progress = progress
         self.host_seconds = time.perf_counter() - t0
         lg.info("sweep finished: %d variants, quanta %s, %.2f host-s",
                 self.num_variants, np.asarray(quanta_v).tolist(),
                 self.host_seconds)
         return self.summaries()
+
+    def _stuck_report(self, done_v, quanta_v) -> str:
+        """Per-lane cursor/clock snapshots for the stuck-lane error: a
+        wedged serve must be diagnosable from the journal's recorded
+        error string alone, without re-running the bucket."""
+        cursor = np.asarray(jax.device_get(self.bstate.cursor))
+        clock = np.asarray(jax.device_get(self.bstate.clock))
+        cursor_v = cursor.reshape(self.num_variants, -1)
+        clock_v = clock.reshape(self.num_variants, -1)
+        stuck = [i for i, d in enumerate(done_v) if not d]
+        lanes = [
+            f"lane {i}: cursor_sum={int(cursor_v[i].sum())} "
+            f"cursor=[{int(cursor_v[i].min())}..{int(cursor_v[i].max())}] "
+            f"clock_ps=[{int(clock_v[i].min())}..{int(clock_v[i].max())}] "
+            f"quanta={int(quanta_v[i])}"
+            for i in stuck]
+        return f"(undone variants: {stuck}; " + "; ".join(lanes) + ")"
 
     def summaries(self) -> List[SimSummary]:
         """Fan the batched final state out into V independent summaries.

@@ -40,6 +40,25 @@ cross-process locking — the deployment story is one serving process per
 queue, restarted by a supervisor).  The fault-injection harness
 (graphite_tpu/testing/faults.py) reaches every failure path above from
 tests and the run_tests.sh kill-and-recover gate.
+
+**Observability (ISSUE 17).**  Every journal record carries wall
+(``ts``) and monotonic (``mono``) timestamps — replay tolerates
+pre-ISSUE-17 records without them — and every lifecycle transition
+feeds the process-wide metrics registry (obs/registry.py):
+``ticket_latency_s`` / ``first_result_latency_s`` histograms,
+``variants_served_total``, ``cache_hits_total`` / ``cache_misses_total``
++ the ``cache_hit_ratio`` gauge, per-state ``tickets_in_state`` gauges,
+and one ``svc_*_total`` counter per ``stats`` key.  Results STREAM: the
+per-lane done poll inside SweepSimulator.run surfaces each lane the
+poll it finishes (``first_result`` journal event + ``on_result``
+callback + the ticket's summary set) instead of at bucket drain, with
+per-drain p50/p99 first-result latency gauges.  ``metrics_path`` writes
+the Prometheus exposition atomically after every drain;
+``obs.chrome_trace(tracer=..., tickets=svc.tickets().values())``
+renders the drain's ticket lifecycles beside the host spans on one
+wall-clock timeline.  All of it is host-side bookkeeping: metrics-off
+runs remain bit-identical — observability never perturbs simulated
+time.
 """
 
 from __future__ import annotations
@@ -57,6 +76,8 @@ from graphite_tpu.config import Config, load_config
 from graphite_tpu.engine.checkpoint import CheckpointCorruptError
 from graphite_tpu.engine.sim import DeadlockError
 from graphite_tpu.events.schema import Trace
+from graphite_tpu.obs.registry import (enable_metrics, get_registry,
+                                       write_exposition)
 from graphite_tpu.params import SimParams
 from graphite_tpu.sweep import batch as batchmod
 from graphite_tpu.sweep.batch import SweepSimulator
@@ -66,7 +87,8 @@ from graphite_tpu.sweep.space import (structural_signature, variant_label,
 from graphite_tpu.testing.faults import FaultInjected
 
 __all__ = ["SweepService", "Ticket", "QUEUED", "RUNNING", "DONE",
-           "FAILED", "QUARANTINED"]
+           "FAILED", "QUARANTINED", "STATES", "read_journal",
+           "journal_status"]
 
 QUEUED = "queued"
 RUNNING = "running"
@@ -75,13 +97,21 @@ FAILED = "failed"          # transient failure exhausted its retries
 QUARANTINED = "quarantined"  # config-attributed: isolated by bisection
 
 TERMINAL = frozenset({DONE, FAILED, QUARANTINED})
+STATES = (QUEUED, RUNNING, DONE, FAILED, QUARANTINED)
 
 
 @dataclass
 class Ticket:
     """One queued design point.  Durable identity is the OVERRIDES dict
     (JSON-able config paths -> values) — params are rebuilt from the
-    journal's base config on restart, never serialized."""
+    journal's base config on restart, never serialized.
+
+    ``marks`` holds THIS-process lifecycle timestamps
+    (``time.perf_counter()`` seconds: submit / running / first_result /
+    done) — the basis of the latency histograms and the Chrome-trace
+    ticket track, sharing the SpanTracer's clock.  ``times`` holds the
+    wall-clock (``time.time()``) versions, which survive journal replay
+    across processes (monotonic clocks don't)."""
 
     ticket: int
     overrides: Dict[str, str]
@@ -91,6 +121,8 @@ class Ticket:
     error: Optional[str] = None
     from_cache: bool = False
     params: Optional[SimParams] = field(default=None, repr=False)
+    marks: Dict[str, float] = field(default_factory=dict, repr=False)
+    times: Dict[str, float] = field(default_factory=dict, repr=False)
 
 
 def _atomic_write_json(path: str, obj) -> None:
@@ -110,6 +142,92 @@ def _atomic_write_json(path: str, obj) -> None:
                 os.unlink(pending)
             except OSError:
                 pass
+
+
+def read_journal(journal_dir: str) -> List[dict]:
+    """All journal records under ``journal_dir``, in sequence order.
+    Record files are whole-or-absent (atomic rename), so reading beside
+    a live service sees a clean prefix, never a torn record."""
+    names = sorted(n for n in os.listdir(journal_dir)
+                   if n.startswith("rec-") and n.endswith(".json"))
+    recs = []
+    for n in names:
+        with open(os.path.join(journal_dir, n)) as f:
+            recs.append(json.load(f))
+    recs.sort(key=lambda r: r.get("seq", 0))
+    return recs
+
+
+def journal_status(journal_dir: str) -> dict:
+    """Fold a journal directory into a status view WITHOUT constructing
+    a service (no trace, no params): per-state counts plus one row per
+    ticket with its wall-clock transition times — the basis of the
+    ``status`` CLI subcommand, safe to point at a live service's
+    journal.  Latencies derive from the records' wall ``ts`` stamps;
+    pre-ISSUE-17 records without them fold into states only."""
+    tickets: Dict[int, dict] = {}
+
+    def row(tid: int) -> dict:
+        return tickets.setdefault(tid, {
+            "ticket": tid, "label": "", "status": QUEUED,
+            "from_cache": False, "error": None, "times": {}})
+
+    for rec in read_journal(journal_dir):
+        ev, ts = rec.get("event"), rec.get("ts")
+
+        def stamp(r: dict, mark: str) -> None:
+            if ts is not None:
+                r["times"][mark] = ts
+
+        if ev == "submit":
+            r = row(rec["ticket"])
+            r["label"] = rec.get("label", "")
+            stamp(r, "submit")
+        elif ev == "running":
+            for tid in rec.get("tickets", ()):
+                r = row(tid)
+                r["status"] = RUNNING
+                stamp(r, "running")
+        elif ev == "first_result":
+            stamp(row(rec["ticket"]), "first_result")
+        elif ev == "done":
+            r = row(rec["ticket"])
+            r["status"] = DONE
+            r["from_cache"] = bool(rec.get("from_cache"))
+            stamp(r, "done")
+        elif ev in ("failed", "quarantined"):
+            r = row(rec["ticket"])
+            r["status"] = FAILED if ev == "failed" else QUARANTINED
+            r["error"] = rec.get("error")
+            stamp(r, "done")
+        elif ev == "requeued":
+            for tid in rec.get("tickets", ()):
+                row(tid)["status"] = QUEUED
+
+    counts = {s: 0 for s in STATES}
+    for r in tickets.values():
+        counts[r["status"]] += 1
+
+    def pct(vals: List[float], q: float) -> Optional[float]:
+        return float(np.percentile(np.asarray(vals), q)) if vals else None
+
+    first = [r["times"]["first_result"] - r["times"]["submit"]
+             for r in tickets.values()
+             if "first_result" in r["times"] and "submit" in r["times"]]
+    done = [r["times"]["done"] - r["times"]["submit"]
+            for r in tickets.values()
+            if r["status"] == DONE and "done" in r["times"]
+            and "submit" in r["times"]]
+    return {
+        "journal_dir": os.path.abspath(journal_dir),
+        "tickets": [tickets[tid] for tid in sorted(tickets)],
+        "counts": counts,
+        "open": counts[QUEUED] + counts[RUNNING],
+        "p50_first_result_s": pct(first, 50),
+        "p99_first_result_s": pct(first, 99),
+        "p50_ticket_latency_s": pct(done, 50),
+        "p99_ticket_latency_s": pct(done, 99),
+    }
 
 
 _results_db_mod = None
@@ -159,6 +277,8 @@ class SweepService:
                  backoff_s: Optional[float] = None,
                  poll_every: Optional[int] = None,
                  max_steps: Optional[int] = None,
+                 metrics_path: Optional[str] = None,
+                 on_result=None,
                  sleep=time.sleep):
         from graphite_tpu.log import get_logger
         self._lg = get_logger("service")
@@ -207,33 +327,121 @@ class SweepService:
         # steps}] in preemption order.
         self._resumable: List[dict] = []
         self.compiles_observed = 0
-        self.stats = {"buckets_run": 0, "cache_hits": 0, "retries": 0,
+        self.stats = {"buckets_run": 0, "cache_hits": 0,
+                      "cache_misses": 0, "retries": 0,
                       "bisections": 0, "preemptions": 0,
                       "quarantined": 0, "failed": 0,
-                      "checkpoints_discarded": 0, "recovered": 0}
+                      "checkpoints_discarded": 0, "recovered": 0,
+                      "first_results": 0}
+        # --- observability: registry handles + callbacks -------------
+        self.metrics_path = metrics_path
+        self.on_result = on_result   # on_result(ticket, row) at first
+        #                              result availability
+        if metrics_path:
+            enable_metrics(True)
+        reg = get_registry()
+        self._m_latency = reg.histogram(
+            "ticket_latency_s", "submit-to-DONE serving latency")
+        self._m_first = reg.histogram(
+            "first_result_latency_s",
+            "submit-to-first-result latency (streamed lane poll)")
+        self._m_served = reg.counter(
+            "variants_served_total",
+            "tickets served to DONE (simulated or cache)")
+        self._m_cache_hits = reg.counter(
+            "cache_hits_total", "tickets served from results_db cache")
+        self._m_cache_misses = reg.counter(
+            "cache_misses_total", "cache lookups that missed")
+        self._m_hit_ratio = reg.gauge(
+            "cache_hit_ratio", "cache_hits / (hits + misses), lifetime")
+        self._m_state = reg.gauge(
+            "tickets_in_state", "tickets currently in each lifecycle "
+            "state", labels=("state",))
+        self._m_drain_p50 = reg.gauge(
+            "first_result_latency_p50_s", "per-drain p50 first-result "
+            "latency (seconds)")
+        self._m_drain_p99 = reg.gauge(
+            "first_result_latency_p99_s", "per-drain p99 first-result "
+            "latency (seconds)")
+        self._first_latencies: List[float] = []
+        self._state_counts = {s: 0 for s in STATES}
+        for s in STATES:   # zero rows for every state in the exposition
+            self._m_state.add(0.0, state=s)
         self._recover()
 
     # ------------------------------------------------------------ journal
 
     def _journal(self, event: str, **fields) -> None:
         self._seq += 1
-        rec = {"seq": self._seq, "event": event}
+        # Wall + monotonic stamps on every record: the status CLI and
+        # cross-restart views read ts; same-process latency/tracing
+        # reads mono (perf_counter — the SpanTracer's clock).  Replay
+        # tolerates their absence (pre-ISSUE-17 journals).
+        rec = {"seq": self._seq, "event": event,
+               "ts": time.time(), "mono": time.perf_counter()}
         rec.update(fields)
         _atomic_write_json(
             os.path.join(self.journal_dir, f"rec-{self._seq:08d}.json"),
             rec)
 
+    # -------------------------------------------------------- obs helpers
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        """stats[key] += n, mirrored into the svc_<key>_total counter."""
+        self.stats[key] += n
+        get_registry().counter(
+            f"svc_{key}_total", f"service {key} events").inc(n)
+
+    def _set_status(self, t: Ticket, status: str) -> None:
+        """Single choke point for status changes: keeps the per-state
+        counts (and their gauges) true to the ticket dict."""
+        if t.status in self._state_counts:
+            self._state_counts[t.status] -= 1
+            self._m_state.add(-1.0, state=t.status)
+        t.status = status
+        self._state_counts[status] += 1
+        self._m_state.add(1.0, state=status)
+
+    def _count_ticket(self, t: Ticket) -> None:
+        """Account a ticket first entering the dict (already carrying
+        its initial status)."""
+        self._state_counts[t.status] += 1
+        self._m_state.add(1.0, state=t.status)
+
+    def _hit_ratio(self) -> Optional[float]:
+        lookups = self.stats["cache_hits"] + self.stats["cache_misses"]
+        if lookups == 0:
+            return None
+        return self.stats["cache_hits"] / lookups
+
+    def _first_result(self, t: Ticket, row: dict) -> None:
+        """A ticket's summary became available (lane-done poll or cache
+        hit): journal it, observe the first-result latency, stream to
+        the on_result callback.  Fires at most once per ticket life."""
+        now = time.perf_counter()
+        t.summary = row
+        t.marks["first_result"] = now
+        t.times["first_result"] = time.time()
+        self._bump("first_results")
+        self._journal("first_result", ticket=t.ticket, summary=row)
+        if "submit" in t.marks:
+            lat = now - t.marks["submit"]
+            self._first_latencies.append(lat)
+            self._m_first.observe(lat)
+        if self.on_result is not None:
+            self.on_result(t, row)
+
     def _recover(self) -> None:
         """Replay the journal into in-memory ticket state.  Record files
         are whole-or-absent (atomic rename), so replay is a straight
-        fold in sequence order."""
-        names = sorted(n for n in os.listdir(self.journal_dir)
-                       if n.startswith("rec-") and n.endswith(".json"))
-        recs = []
-        for n in names:
-            with open(os.path.join(self.journal_dir, n)) as f:
-                recs.append(json.load(f))
-        recs.sort(key=lambda r: r.get("seq", 0))
+        fold in sequence order.  Timestamps (``ts``) are optional —
+        pre-ISSUE-17 journals replay identically, just without times."""
+        recs = read_journal(self.journal_dir)
+
+        def stamp(t, mark, rec):
+            if rec.get("ts") is not None:
+                t.times[mark] = rec["ts"]
+
         for rec in recs:
             ev = rec.get("event")
             if ev == "submit":
@@ -241,22 +449,34 @@ class SweepService:
                            overrides=dict(rec["overrides"]),
                            label=rec.get("label", ""))
                 self._tickets[t.ticket] = t
+                self._count_ticket(t)
+                stamp(t, "submit", rec)
             elif ev == "running":
                 for tid in rec.get("tickets", ()):
                     if tid in self._tickets:
-                        self._tickets[tid].status = RUNNING
+                        t = self._tickets[tid]
+                        self._set_status(t, RUNNING)
+                        stamp(t, "running", rec)
+            elif ev == "first_result":
+                t = self._tickets.get(rec["ticket"])
+                if t is not None and t.summary is None:
+                    t.summary = rec.get("summary")
+                    stamp(t, "first_result", rec)
             elif ev == "done":
                 t = self._tickets.get(rec["ticket"])
                 if t is not None:
-                    t.status = DONE
+                    self._set_status(t, DONE)
                     t.summary = rec.get("summary")
                     t.from_cache = bool(rec.get("from_cache"))
+                    stamp(t, "done", rec)
                 self._drop_resumable(rec["ticket"])
             elif ev in ("failed", "quarantined"):
                 t = self._tickets.get(rec["ticket"])
                 if t is not None:
-                    t.status = FAILED if ev == "failed" else QUARANTINED
+                    self._set_status(
+                        t, FAILED if ev == "failed" else QUARANTINED)
                     t.error = rec.get("error")
+                    stamp(t, "done", rec)
                 self._drop_resumable(rec["ticket"])
             elif ev == "preempted":
                 self._drop_resumable(*rec.get("tickets", ()))
@@ -267,7 +487,7 @@ class SweepService:
             elif ev == "requeued":
                 for tid in rec.get("tickets", ()):
                     if tid in self._tickets:
-                        self._tickets[tid].status = QUEUED
+                        self._set_status(self._tickets[tid], QUEUED)
                 self._drop_resumable(*rec.get("tickets", ()))
         if self._tickets:
             self._next_ticket = max(self._tickets) + 1
@@ -285,8 +505,8 @@ class SweepService:
             self._journal("requeued", tickets=requeue,
                           reason="recovered in-flight work")
             for tid in requeue:
-                self._tickets[tid].status = QUEUED
-            self.stats["recovered"] += len(requeue)
+                self._set_status(self._tickets[tid], QUEUED)
+            self._bump("recovered", len(requeue))
         if self._tickets:
             self._lg.info(
                 "service recovered %d tickets (%d requeued, %d "
@@ -312,6 +532,9 @@ class SweepService:
         t.params = self._build_params(overrides)
         self._next_ticket += 1
         self._tickets[t.ticket] = t
+        t.marks["submit"] = time.perf_counter()
+        t.times["submit"] = time.time()
+        self._count_ticket(t)
         self._journal("submit", ticket=t.ticket, overrides=overrides,
                       label=t.label)
         return t.ticket
@@ -358,11 +581,27 @@ class SweepService:
             "SELECT raw_json FROM runs WHERE workload = ? "
             "ORDER BY ts DESC, id DESC LIMIT 1", (key,)).fetchone()
         if row is None:
+            # Misses are counted only when a lookup actually ran (db
+            # configured), so cache_hit_ratio reads hits/lookups.
+            self._bump("cache_misses")
+            self._m_cache_misses.inc()
+            ratio = self._hit_ratio()
+            if ratio is not None:
+                self._m_hit_ratio.set(ratio)
             return False
-        t.status = DONE
-        t.summary = json.loads(row[0])
+        self._first_result(t, json.loads(row[0]))
+        self._set_status(t, DONE)
         t.from_cache = True
-        self.stats["cache_hits"] += 1
+        t.marks["done"] = time.perf_counter()
+        t.times["done"] = time.time()
+        self._bump("cache_hits")
+        self._m_cache_hits.inc()
+        self._m_served.inc()
+        ratio = self._hit_ratio()
+        if ratio is not None:
+            self._m_hit_ratio.set(ratio)
+        if "submit" in t.marks:
+            self._m_latency.observe(t.marks["done"] - t.marks["submit"])
         self._journal("done", ticket=t.ticket, summary=t.summary,
                       from_cache=True)
         return True
@@ -389,6 +628,7 @@ class SweepService:
         quarantine).  Tickets still RUNNING afterwards were preempted
         this pass and have a checkpoint on disk — drain again (or
         serve()) to continue them."""
+        seen = len(self._first_latencies)
         for rec in list(self._resumable):
             self._resume_bucket(rec)
         for t in sorted(self._tickets.values(), key=lambda t: t.ticket):
@@ -407,6 +647,11 @@ class SweepService:
             buckets[sig].append(t)
         for sig in order:
             self._run_bucket(buckets[sig])
+        fresh = self._first_latencies[seen:]
+        if fresh:
+            self._m_drain_p50.set(float(np.percentile(fresh, 50)))
+            self._m_drain_p99.set(float(np.percentile(fresh, 99)))
+        self.write_metrics()
         return self.tickets()
 
     def serve(self) -> Dict[int, Ticket]:
@@ -427,8 +672,12 @@ class SweepService:
 
     def _mark_running(self, items: List[Ticket]) -> None:
         fresh = [t.ticket for t in items if t.status != RUNNING]
+        now, wall = time.perf_counter(), time.time()
         for t in items:
-            t.status = RUNNING
+            if t.status != RUNNING:
+                self._set_status(t, RUNNING)
+                t.marks.setdefault("running", now)
+                t.times.setdefault("running", wall)
         if fresh:
             self._journal("running", tickets=fresh)
 
@@ -448,7 +697,7 @@ class SweepService:
                 attempt += 1
                 if attempt <= self.max_retries:
                     delay = self.backoff_s * (2 ** (attempt - 1))
-                    self.stats["retries"] += 1
+                    self._bump("retries")
                     self._lg.warning(
                         "bucket %s failed (%s); retry %d/%d in %.3fs",
                         [t.ticket for t in items], e, attempt,
@@ -458,7 +707,7 @@ class SweepService:
                     continue
                 if len(items) > 1:
                     mid = len(items) // 2
-                    self.stats["bisections"] += 1
+                    self._bump("bisections")
                     self._lg.warning(
                         "bucket %s still failing after %d retries; "
                         "bisecting", [t.ticket for t in items],
@@ -471,11 +720,24 @@ class SweepService:
 
     def _execute(self, items: List[Ticket], sim: SweepSimulator) -> None:
         before = batchmod.compile_count()
+
+        def lane_done(lane: int, s) -> None:
+            # Padding lanes (>= len(items)) replicate the last real
+            # variant; retried/resumed lanes may already have streamed.
+            if lane >= len(items):
+                return
+            t = items[lane]
+            if (t.summary is not None or "first_result" in t.marks
+                    or t.status in TERMINAL):
+                return
+            self._first_result(t, self._summary_row(s))
+
         summaries = sim.run(max_steps=self.max_steps,
                             poll_every=self.poll_every,
-                            budget_s=self.budget_s)
+                            budget_s=self.budget_s,
+                            on_lane_done=lane_done)
         self.compiles_observed += batchmod.compile_count() - before
-        self.stats["buckets_run"] += 1
+        self._bump("buckets_run")
         if sim.preempted:
             self._preempt(items, sim)
             return
@@ -493,9 +755,22 @@ class SweepService:
         return row
 
     def _complete(self, t: Ticket, row: dict) -> None:
-        t.status = DONE
+        # A streamed lane already observed first_result; if it never
+        # streamed (e.g. the whole bucket finished within one poll of a
+        # resume), the first availability IS completion.
+        if t.summary is None and "first_result" not in t.marks:
+            self._first_result(t, row)
+        self._set_status(t, DONE)
+        # Determinism makes the streamed mid-run summary and the final
+        # one bit-identical for a done lane; overwrite keeps the final
+        # row authoritative anyway.
         t.summary = row
         t.from_cache = False
+        t.marks["done"] = time.perf_counter()
+        t.times["done"] = time.time()
+        self._m_served.inc()
+        if "submit" in t.marks:
+            self._m_latency.observe(t.marks["done"] - t.marks["submit"])
         self._journal("done", ticket=t.ticket, summary=row,
                       from_cache=False)
         self._store(t, row)
@@ -503,16 +778,18 @@ class SweepService:
     def _terminal_failure(self, t: Ticket, e: Exception) -> None:
         err = f"{type(e).__name__}: {e}"
         t.error = err
+        t.marks["done"] = time.perf_counter()
+        t.times["done"] = time.time()
         if isinstance(e, FaultInjected) and e.transient:
             # Retries exhausted on a TRANSIENT fault: the config is not
             # proven poisonous — mark failed, not quarantined, so an
             # operator resubmits rather than blacklists.
-            t.status = FAILED
-            self.stats["failed"] += 1
+            self._set_status(t, FAILED)
+            self._bump("failed")
             self._journal("failed", ticket=t.ticket, error=err)
         else:
-            t.status = QUARANTINED
-            self.stats["quarantined"] += 1
+            self._set_status(t, QUARANTINED)
+            self._bump("quarantined")
             self._journal("quarantined", ticket=t.ticket, error=err)
         self._lg.error("ticket %d (%s) %s: %s", t.ticket, t.label,
                        t.status, err)
@@ -532,7 +809,7 @@ class SweepService:
         self._journal("preempted", **rec)
         self._drop_resumable(*rec["tickets"])
         self._resumable.append(rec)
-        self.stats["preemptions"] += 1
+        self._bump("preemptions")
         self._lg.info("bucket %s preempted at step %d -> %s",
                       rec["tickets"], sim.steps, path)
 
@@ -553,7 +830,7 @@ class SweepService:
             self._lg.warning("discarding checkpoint %s (%s); re-running "
                              "bucket %s from scratch", rec["checkpoint"],
                              e, rec["tickets"])
-            self.stats["checkpoints_discarded"] += 1
+            self._bump("checkpoints_discarded")
             self._drop_resumable(*rec["tickets"])
             try:
                 os.unlink(rec["checkpoint"])
@@ -581,7 +858,33 @@ class SweepService:
                 except OSError:
                     pass
 
-    # ------------------------------------------------------------ results
+    # ------------------------------------------------- metrics / results
+
+    def write_metrics(self) -> Optional[str]:
+        """Atomically write the Prometheus exposition to
+        ``metrics_path`` (no-op when unset); called after every drain
+        so a scraper never sees a half-served pass."""
+        if not self.metrics_path:
+            return None
+        write_exposition(self.metrics_path)
+        return self.metrics_path
+
+    def latency_stats(self) -> dict:
+        """Serving-latency summary from THIS process's observations
+        (plain Python — independent of whether the registry is
+        enabled): p50/p99 submit-to-first-result seconds plus the
+        lifetime cache-hit ratio.  The numbers bench.py publishes."""
+        lat = self._first_latencies
+
+        def pct(q: float) -> Optional[float]:
+            return float(np.percentile(lat, q)) if lat else None
+
+        return {
+            "first_results": len(lat),
+            "p50_first_result_s": pct(50),
+            "p99_first_result_s": pct(99),
+            "cache_hit_ratio": self._hit_ratio(),
+        }
 
     def result_rows(self) -> Dict[str, dict]:
         """{label: summary row} for every DONE ticket (labels collide

@@ -1,0 +1,253 @@
+"""Metrics registry tests (obs/registry.py, ISSUE 17): counter/gauge/
+histogram semantics, hand-checked percentile interpolation, exposition
+render -> parse round-trip, disabled null-path, and label handling.
+
+All tests use a LOCAL MetricsRegistry (not the process-global one) so
+they can't perturb — or be perturbed by — service tests that run in the
+same process.
+"""
+
+import math
+
+import pytest
+
+from graphite_tpu.obs.registry import (
+    DEFAULT_LATENCY_BUCKETS, MetricsRegistry, enable_metrics,
+    get_registry, metrics_enabled, parse_exposition, render_exposition,
+    write_exposition)
+
+pytestmark = pytest.mark.quick
+
+
+def _reg():
+    return MetricsRegistry(enabled=True)
+
+
+# ------------------------------------------------------------- counters
+
+def test_counter_inc_and_labels():
+    reg = _reg()
+    c = reg.counter("requests_total", "requests", labels=("code",))
+    c.inc(code="200")
+    c.inc(2.5, code="200")
+    c.inc(code="500")
+    assert c.value(code="200") == 3.5
+    assert c.value(code="500") == 1.0
+    assert c.value(code="404") == 0.0
+
+
+def test_counter_rejects_negative():
+    c = _reg().counter("c", "c")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_label_set_mismatch_rejected():
+    c = _reg().counter("c", "c", labels=("a",))
+    with pytest.raises(ValueError):
+        c.inc(b="x")
+    with pytest.raises(ValueError):
+        c.inc()   # missing the declared label entirely
+
+
+def test_reregistration_same_name_same_kind_is_get():
+    reg = _reg()
+    a = reg.counter("c", "c")
+    b = reg.counter("c", "other help ignored")
+    assert a is b
+
+
+def test_reregistration_kind_conflict_raises():
+    reg = _reg()
+    reg.counter("m", "m")
+    with pytest.raises(ValueError):
+        reg.gauge("m", "m")
+    with pytest.raises(ValueError):
+        reg.counter("m", "m", labels=("x",))
+
+
+# ------------------------------------------------------------ histogram
+
+def test_histogram_bucketing_and_count_sum():
+    reg = _reg()
+    h = reg.histogram("lat", "lat", bounds=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.total() == pytest.approx(105.0)
+    # cumulative bucket rows: le=1 ->1, le=2 ->2, le=4 ->3, +Inf ->4
+    rows = {s[1]["le"]: s[2] for s in h.samples()
+            if s[0] == "lat_bucket"}
+    assert rows == {"1": 1.0, "2": 2.0, "4": 3.0, "+Inf": 4.0}
+
+
+def test_histogram_percentile_hand_checked():
+    """10 observations spread 1 per bucket edge-exclusive: percentile
+    math is linear interpolation inside the crossing bucket."""
+    h = _reg().histogram("lat", "lat", bounds=(10.0, 20.0, 30.0))
+    # 2 in (0,10], 6 in (10,20], 2 in (20,30]
+    for v in (5, 7, 11, 12, 13, 17, 18, 19, 25, 28):
+        h.observe(v)
+    # p50: target rank 5. Bucket (10,20] holds ranks 3..8; frac =
+    # (5-2)/6 = 0.5 -> 10 + 0.5*10 = 15.
+    assert h.percentile(0.5) == pytest.approx(15.0)
+    # p90: target 9 -> bucket (20,30], frac (9-8)/2 = 0.5 -> 25.
+    assert h.percentile(0.9) == pytest.approx(25.0)
+    # p0 clamps to the bucket floor, p1 lands on the last bound.
+    assert h.percentile(1.0) == pytest.approx(30.0)
+
+
+def test_histogram_percentile_overflow_clamps():
+    h = _reg().histogram("lat", "lat", bounds=(1.0, 2.0))
+    h.observe(50.0)   # +Inf bucket only
+    assert h.percentile(0.5) == pytest.approx(2.0)
+
+
+def test_histogram_percentile_empty_and_range():
+    h = _reg().histogram("lat", "lat", bounds=(1.0,))
+    assert h.percentile(0.5) is None
+    h.observe(0.5)
+    with pytest.raises(ValueError):
+        h.percentile(1.5)
+    with pytest.raises(ValueError):
+        h.percentile(-0.1)
+
+
+def test_histogram_bounds_must_increase():
+    with pytest.raises(ValueError):
+        _reg().histogram("h", "h", bounds=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        _reg().histogram("h", "h", bounds=(1.0, 1.0))
+
+
+def test_default_buckets_cover_serving_range():
+    assert DEFAULT_LATENCY_BUCKETS[0] <= 0.001
+    assert DEFAULT_LATENCY_BUCKETS[-1] >= 300.0
+    assert list(DEFAULT_LATENCY_BUCKETS) == sorted(DEFAULT_LATENCY_BUCKETS)
+
+
+# ------------------------------------------------------- disabled paths
+
+def test_disabled_registry_is_inert():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("c", "c")
+    g = reg.gauge("g", "g")
+    h = reg.histogram("h", "h")
+    c.inc()
+    g.set(5)
+    h.observe(1.0)
+    assert c.value() == 0.0
+    assert g.value() == 0.0
+    assert h.count() == 0
+    # No sample rows either: the exposition of an untouched registry
+    # has headers only (one family per registered metric).
+    text = render_exposition(reg)
+    assert parse_exposition(text) == {}
+
+
+def test_enable_metrics_toggles_global():
+    was = metrics_enabled()
+    try:
+        reg = enable_metrics(True)
+        assert reg is get_registry()
+        assert metrics_enabled()
+        enable_metrics(False)
+        assert not metrics_enabled()
+    finally:
+        get_registry().enabled = was
+
+
+# ----------------------------------------------------------- exposition
+
+def test_exposition_roundtrip():
+    reg = _reg()
+    reg.counter("req_total", "reqs", labels=("code",)).inc(3, code="200")
+    reg.counter("req_total", "reqs", labels=("code",)).inc(code="500")
+    reg.gauge("temp", "temperature").set(36.6)
+    h = reg.histogram("lat", "latency", bounds=(0.5, 1.0))
+    h.observe(0.25)
+    h.observe(0.75)
+    h.observe(9.0)
+    text = render_exposition(reg)
+    assert "# HELP req_total reqs" in text
+    assert "# TYPE lat histogram" in text
+    parsed = parse_exposition(text)
+    assert ({"code": "200"}, 3.0) in parsed["req_total"]
+    assert ({"code": "500"}, 1.0) in parsed["req_total"]
+    assert parsed["temp"] == [({}, 36.6)]
+    buckets = {tuple(sorted(l.items())): v
+               for l, v in parsed["lat_bucket"]}
+    assert buckets[(("le", "0.5"),)] == 1.0
+    assert buckets[(("le", "1"),)] == 2.0
+    assert buckets[(("le", "+Inf"),)] == 3.0
+    assert parsed["lat_sum"] == [({}, 10.0)]
+    assert parsed["lat_count"] == [({}, 3.0)]
+
+
+def test_exposition_escapes_label_values():
+    reg = _reg()
+    reg.counter("c", "c", labels=("path",)).inc(
+        path='a"b\\c\nd')
+    parsed = parse_exposition(render_exposition(reg))
+    assert parsed["c"] == [({"path": 'a"b\\c\nd'}, 1.0)]
+
+
+def test_parse_rejects_malformed():
+    for bad in ("metric_without_value",
+                'm{unterminated="x value',
+                'm{a="1"} not_a_number',
+                "m{a=unquoted} 1"):
+        with pytest.raises(ValueError):
+            parse_exposition(bad)
+
+
+def test_parse_skips_comments_and_blanks():
+    assert parse_exposition("# HELP x y\n\n# TYPE x counter\n") == {}
+
+
+def test_write_exposition_atomic(tmp_path):
+    reg = _reg()
+    reg.counter("c", "c").inc(7)
+    path = tmp_path / "metrics.prom"
+    write_exposition(str(path), reg)
+    parsed = parse_exposition(path.read_text())
+    assert parsed["c"] == [({}, 7.0)]
+    # No tmp droppings left beside the target.
+    assert [p.name for p in tmp_path.iterdir()] == ["metrics.prom"]
+
+
+def test_snapshot_json_shape():
+    import json
+    reg = _reg()
+    reg.gauge("g", "g", labels=("k",)).set(2, k="a")
+    snap = reg.snapshot()
+    assert snap == {"g": [[{"k": "a"}, 2.0]]}
+    json.dumps(snap)   # plain JSON types by contract
+
+
+def test_value_formatting_integers_stay_integers():
+    reg = _reg()
+    reg.counter("c", "c").inc(3)
+    text = render_exposition(reg)
+    assert "\nc 3\n" in text
+    assert not math.isnan(parse_exposition(text)["c"][0][1])
+
+
+def test_gauge_add_composes_across_writers():
+    """Delta updates from independent writers (e.g. two SweepServices in
+    one process feeding tickets_in_state) accumulate; absolute set()
+    still wins afterwards, and both are disabled-registry no-ops."""
+    reg = _reg()
+    g = reg.gauge("tickets", "t", labels=("state",))
+    g.add(0.0, state="done")      # zero row appears in the exposition
+    g.add(1.0, state="done")
+    g.add(1.0, state="done")      # a second writer
+    g.add(-1.0, state="queued")   # deltas may be negative
+    assert g.value(state="done") == 2.0
+    assert g.value(state="queued") == -1.0
+    g.set(5.0, state="done")
+    assert g.value(state="done") == 5.0
+    off = MetricsRegistry(enabled=False)
+    go = off.gauge("g", "g")
+    go.add(3.0)
+    assert go.value() == 0.0

@@ -215,6 +215,69 @@ def test_span_tracer_disabled_records_nothing():
     assert tr.events == []
 
 
+def test_span_tracer_bounds_event_buffer():
+    """ISSUE 17 satellite: a long-lived serving process must not grow the
+    span list without limit — past max_events new spans are dropped (the
+    oldest spans win, holding the compile story) and counted both on the
+    tracer and in the process-wide spans_dropped_total registry counter."""
+    from graphite_tpu.obs import SpanTracer
+    from graphite_tpu.obs.registry import enable_metrics, get_registry
+    was = get_registry().enabled
+    reg = enable_metrics(True, reset=True)
+    try:
+        tr = SpanTracer(enabled=True, max_events=3)
+        for i in range(5):
+            with tr.span(f"s{i}"):
+                pass
+        assert [e.name for e in tr.events] == ["s0", "s1", "s2"]
+        assert tr.dropped == 2
+        assert reg.counter("spans_dropped_total").value() == 2
+        # clear() resets the buffer AND the drop count; recording resumes
+        tr.clear()
+        assert tr.events == [] and tr.dropped == 0
+        with tr.span("again"):
+            pass
+        assert [e.name for e in tr.events] == ["again"]
+        # disabled registry: the tracer-side count still works alone
+        enable_metrics(False, reset=True)
+        tr2 = SpanTracer(enabled=True, max_events=1)
+        for i in range(3):
+            with tr2.span(f"t{i}"):
+                pass
+        assert tr2.dropped == 2
+        assert get_registry().counter("spans_dropped_total").value() == 0
+    finally:
+        enable_metrics(was, reset=True)
+
+
+def test_derive_rates_clock_skew_and_zero_round_windows():
+    """ISSUE 17 satellite: derive_rates publishes clock_skew_ps
+    (= clock_max − clock_min, full length n) and a window with zero
+    retirement rounds reads 0 events/round instead of dividing by the
+    round count (idle or fast-forwarded windows retire events without
+    spending rounds)."""
+    from graphite_tpu.obs.metrics import derive_rates
+    series = {
+        "events_retired": np.array([0, 10, 25, 55], dtype=np.int64),
+        "rounds_window": np.array([0, 5, 5, 15], dtype=np.int64),
+        "rounds_complex": np.array([0, 0, 0, 0], dtype=np.int64),
+        "clock_min_ps": np.array([0, 100, 200, 300], dtype=np.int64),
+        "clock_max_ps": np.array([0, 150, 280, 300], dtype=np.int64),
+    }
+    r = derive_rates(series)
+    assert np.array_equal(r["d_events_retired"], [10, 15, 30])
+    # window 2 retired 15 events across ZERO rounds: the guard reports
+    # 0.0 (no rounds to attribute to), never 15/0 or 15/1
+    assert np.array_equal(r["events_per_round"], [2.0, 0.0, 3.0])
+    assert np.all(np.isfinite(r["events_per_round"]))
+    # skew is instantaneous: length n (not differenced), max - min
+    assert np.array_equal(r["clock_skew_ps"], [0, 50, 80, 0])
+    assert len(r["clock_skew_ps"]) == len(series["clock_max_ps"])
+    # skew requires both gauges; partial series simply omits it
+    assert "clock_skew_ps" not in derive_rates(
+        {"clock_max_ps": series["clock_max_ps"]})
+
+
 @functools.lru_cache(maxsize=1)
 def _telemetry_run():
     """Two tiles x five 400-cycle computes (10 instructions each), with a

@@ -36,7 +36,8 @@ from graphite_tpu.params import SimParams
 from graphite_tpu.sweep import SweepService
 from graphite_tpu.sweep import batch as batchmod
 from graphite_tpu.sweep.service import (DONE, FAILED, QUARANTINED,
-                                        QUEUED, RUNNING)
+                                        QUEUED, RUNNING, journal_status,
+                                        read_journal)
 from graphite_tpu.testing import faults
 
 pytestmark = pytest.mark.quick
@@ -330,3 +331,200 @@ def test_cache_serves_resubmission_with_zero_work(trace, tmp_path):
     assert r3[t3].status == DONE and not r3[t3].from_cache
     assert svc3.stats["cache_hits"] == 0
     assert svc3.stats["buckets_run"] == 1
+
+
+# ------------------------------------- ISSUE 17: observability/streaming
+
+def test_on_result_streams_lane_before_drain_completes(trace, tmp_path):
+    """ACCEPTANCE: with two design points of very different simulated
+    length in ONE bucket, the fast lane's result is observable (journal
+    ``first_result`` record + ``on_result`` callback + ticket summary
+    set) at the poll it finishes — while the slow ticket demonstrably
+    has no summary yet — and the streamed row is bit-identical to the
+    final one.  The 100ns barrier quantum + poll_every=1 stretch the
+    tiny trace over multiple polling windows."""
+    cfg = _cfg(**{"clock_skew_management/lax_barrier/quantum": 100})
+    jd = tmp_path / "jd"
+    seen = []
+
+    def on_result(t, row):
+        others = [o for o in svc.tickets().values()
+                  if o.ticket != t.ticket]
+        seen.append((t.ticket, dict(row),
+                     [o.summary is None for o in others]))
+
+    svc = _mk(trace, jd, cfg, poll_every=1, on_result=on_result)
+    fast = svc.submit({"dram/latency": "60"})
+    slow = svc.submit({"dram/latency": "400"})
+    res = svc.serve()
+    assert [res[fast].status, res[slow].status] == [DONE, DONE]
+
+    # Both streamed, fast first; at the fast callback the slow ticket
+    # had NO summary — the lane was delivered before the drain finished.
+    assert [s[0] for s in seen] == [fast, slow]
+    assert seen[0][2] == [True]
+    assert seen[1][2] == [False]
+    # Streamed row == final row (masked loop freezes done lanes).
+    assert seen[0][1]["clock_ps"] == res[fast].summary["clock_ps"]
+    assert res[fast].summary["clock_ps"] == \
+        _solo_clock_ps(cfg, trace, {"dram/latency": "60"})
+
+    # Journal ordering: each first_result lands strictly before ANY
+    # done record, and fast's before slow's.
+    recs = read_journal(jd)
+    seq = {}
+    for r in recs:
+        if r["event"] == "first_result":
+            seq.setdefault(("fr", r["ticket"]), r["seq"])
+        elif r["event"] == "done":
+            seq.setdefault(("done", r["ticket"]), r["seq"])
+    assert seq[("fr", fast)] < seq[("fr", slow)]
+    assert max(seq[("fr", fast)], seq[("fr", slow)]) < \
+        min(seq[("done", fast)], seq[("done", slow)])
+    assert svc.stats["first_results"] == 2
+
+    lat = svc.latency_stats()
+    assert lat["first_results"] == 2
+    assert lat["p50_first_result_s"] > 0
+    assert lat["p99_first_result_s"] >= lat["p50_first_result_s"]
+
+
+def test_journal_replay_without_timestamps(trace, tmp_path):
+    """Pre-ISSUE-17 journals carry no ts/mono fields (and no
+    first_result records): stripping them from a fresh journal must
+    replay to identical ticket state — timestamps are additive."""
+    cfg = _cfg()
+    jd = tmp_path / "jd"
+    svc = _mk(trace, jd, cfg)
+    tids = [svc.submit({"dram/latency": v}) for v in ("80", "120")]
+    res = svc.serve()
+    for n in sorted(os.listdir(jd)):
+        if not n.startswith("rec-"):
+            continue
+        with open(jd / n) as f:
+            rec = json.load(f)
+        rec.pop("ts", None)
+        rec.pop("mono", None)
+        if rec["event"] == "first_result":
+            os.unlink(jd / n)
+            continue
+        with open(jd / n, "w") as f:
+            json.dump(rec, f)
+
+    svc2 = _mk(trace, jd, cfg)
+    res2 = svc2.tickets()
+    for t in tids:
+        assert res2[t].status == DONE
+        assert res2[t].summary == res[t].summary
+        assert res2[t].times == {}      # no stamps to recover
+    svc2.serve()
+    assert svc2.stats["buckets_run"] == 0
+
+    # journal_status folds the stripped journal too: states intact,
+    # latency percentiles None (no wall times to derive them from).
+    st = journal_status(jd)
+    assert st["counts"][DONE] == 2
+    assert st["p99_first_result_s"] is None
+    assert st["p99_ticket_latency_s"] is None
+
+
+def test_journal_status_view(trace, tmp_path):
+    """journal_status folds a live journal without a trace or params:
+    per-state counts, per-ticket rows with wall-clock marks, latency
+    percentiles."""
+    cfg = _cfg()
+    jd = tmp_path / "jd"
+    svc = _mk(trace, jd, cfg)
+    tids = [svc.submit({"dram/latency": v}) for v in ("90", "110")]
+    svc.serve()
+    st = journal_status(jd)
+    assert st["counts"][DONE] == 2 and st["open"] == 0
+    rows = {r["ticket"]: r for r in st["tickets"]}
+    for t in tids:
+        assert rows[t]["status"] == DONE
+        assert rows[t]["times"]["submit"] <= rows[t]["times"]["done"]
+        assert "first_result" in rows[t]["times"]
+    assert st["p99_first_result_s"] >= 0
+    assert st["p99_ticket_latency_s"] >= st["p50_ticket_latency_s"]
+
+
+def test_ticket_marks_feed_chrome_trace(trace, tmp_path):
+    """Live tickets render as lifecycle slices on the SERVICE_PID track
+    of the Chrome trace, beside (same wall-clock axis as) host spans."""
+    from graphite_tpu.obs.export import SERVICE_PID, chrome_trace
+
+    cfg = _cfg()
+    svc = _mk(trace, tmp_path / "jd", cfg)
+    tid = svc.submit({"dram/latency": "100"})
+    res = svc.serve()
+    ct = chrome_trace(tickets=res.values())
+    slices = [e for e in ct["traceEvents"]
+              if e["ph"] == "X" and e["pid"] == SERVICE_PID]
+    names = {e["name"] for e in slices}
+    assert {"queued", "running"} <= names
+    assert all(e["dur"] >= 0 for e in slices)
+    assert all(e["args"]["status"] == DONE for e in slices)
+    assert {e["tid"] for e in slices} == {tid}
+    # Replayed tickets carry no live marks -> no slices, no crash.
+    svc2 = _mk(trace, tmp_path / "jd", cfg)
+    assert chrome_trace(
+        tickets=svc2.tickets().values())["traceEvents"] == []
+
+
+def test_metrics_registry_counts_serve_and_cache(trace, tmp_path):
+    """ticket_latency_s counts every DONE (simulated + cached),
+    cache_hits_total counts the cache serve, and the exposition written
+    to metrics_path parses back to the same numbers."""
+    from graphite_tpu.obs.registry import (enable_metrics, get_registry,
+                                           parse_exposition)
+
+    reg = get_registry()
+    was = reg.enabled
+    enable_metrics(True, reset=True)
+    try:
+        cfg = _cfg()
+        db = str(tmp_path / "results.db")
+        mp = str(tmp_path / "metrics.prom")
+        svc = _mk(trace, tmp_path / "j1", cfg, db_path=db,
+                  metrics_path=mp)
+        svc.submit({"dram/latency": "100"})
+        svc.serve()
+        svc2 = _mk(trace, tmp_path / "j2", cfg, db_path=db,
+                   metrics_path=mp)
+        svc2.submit({"dram/latency": "100"})
+        svc2.serve()
+
+        parsed = parse_exposition(open(mp).read())
+        assert parsed["ticket_latency_s_count"] == [({}, 2.0)]
+        assert parsed["cache_hits_total"] == [({}, 1.0)]
+        assert parsed["variants_served_total"] == [({}, 2.0)]
+        assert parsed["cache_hit_ratio"] == [({}, 1.0)]
+        states = {l["state"]: v for l, v in parsed["tickets_in_state"]}
+        assert states[DONE] == 2.0
+        assert svc2.latency_stats()["cache_hit_ratio"] == 1.0
+        # Histogram family parses with cumulative buckets ending at the
+        # count.
+        buckets = [v for l, v in parsed["ticket_latency_s_bucket"]
+                   if l["le"] == "+Inf"]
+        assert buckets == [2.0]
+    finally:
+        enable_metrics(was, reset=True)
+
+
+def test_metrics_disabled_service_still_reports_latency(trace, tmp_path):
+    """Without metrics_path the registry stays off (null-path) but the
+    service's own latency_stats still work — bench.py's numbers don't
+    depend on the scrape surface."""
+    from graphite_tpu.obs.registry import get_registry
+
+    assert not get_registry().enabled
+    cfg = _cfg()
+    svc = _mk(trace, tmp_path / "jd", cfg)
+    svc.submit({"dram/latency": "100"})
+    svc.serve()
+    lat = svc.latency_stats()
+    assert lat["first_results"] == 1
+    assert lat["p99_first_result_s"] > 0
+    assert lat["cache_hit_ratio"] is None    # no db -> no lookups
+    # The disabled registry recorded nothing.
+    assert get_registry().histogram("ticket_latency_s").count() == 0

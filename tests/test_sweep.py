@@ -298,3 +298,65 @@ def test_drain_mid_failure_keeps_completed_buckets():
     assert sorted(results) == sorted([t1, t2, t3])
     solo = Simulator(pa1, trace).run()
     _assert_lane_equals_solo(results[t1], solo, "retained bucket lane 0")
+
+
+def test_on_lane_done_streams_fast_lane_early():
+    """ISSUE 17 streaming at the SweepSimulator level: with two lanes of
+    very different simulated length (DRAM latency 60 vs 400) and
+    poll_every=1 over 100ns barrier windows, the fast lane's
+    ``on_lane_done`` callback fires at an EARLIER device step than the
+    loop's last — the result is observable before the batch drains —
+    and the streamed summary is bit-identical to the final one (masked
+    loop freezes done lanes)."""
+    cfg = load_config()
+    cfg.set("general/total_cores", 4)
+    cfg.set("clock_skew_management/lax_barrier/quantum", 100)
+    trace = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8,
+                            seed=1)
+    variants = build_variants(cfg, ["dram/latency=60,400"])
+    sim = batchmod.SweepSimulator([p for _, _, p in variants], trace)
+    seen = []
+    summaries = sim.run(
+        poll_every=1,
+        on_lane_done=lambda lane, s: seen.append((lane, sim.steps, s)))
+    assert all(s.done.all() for s in summaries)
+    # Both lanes streamed exactly once, fast lane (lane 0) first and at
+    # a strictly earlier poll than the run's final step.
+    assert [lane for lane, _, _ in seen] == [0, 1]
+    assert sim.lane_done_step[0] < sim.steps
+    assert sim.lane_done_step[0] < sim.lane_done_step[1]
+    # Streamed summary == final summary for the early lane, bitwise.
+    streamed = seen[0][2]
+    np.testing.assert_array_equal(np.asarray(streamed.clock),
+                                  np.asarray(summaries[0].clock))
+    assert int(streamed.completion_time_ps) == \
+        int(summaries[0].completion_time_ps)
+    for k in streamed.counters:
+        np.testing.assert_array_equal(
+            np.asarray(streamed.counters[k]),
+            np.asarray(summaries[0].counters[k]), err_msg=k)
+
+
+def test_stuck_lane_error_carries_per_lane_snapshots():
+    """ISSUE 17 satellite: a wedged sweep's DeadlockError must be
+    diagnosable from the recorded error string alone — it names the
+    undone lanes and carries each one's cursor/clock/quanta snapshot
+    (the string lands in the service journal on quarantine)."""
+    from graphite_tpu.engine.sim import DeadlockError
+    from graphite_tpu.events.schema import TraceBuilder
+
+    tb = TraceBuilder(4)
+    for t in range(4):
+        tb.barrier(t, 0, 5)         # 5 participants never arrive
+    trace = tb.build()
+    variants = [
+        _params(**{"general/total_cores": 4, "dram/latency": v})
+        for v in (80, 120)]
+    sim = batchmod.SweepSimulator(variants, trace)
+    with pytest.raises(DeadlockError) as ei:
+        sim.run(poll_every=2)
+    msg = str(ei.value)
+    assert "undone variants: [0, 1]" in msg
+    for lane in (0, 1):
+        assert f"lane {lane}: cursor_sum=" in msg
+    assert "clock_ps=[" in msg and "quanta=" in msg

@@ -31,9 +31,11 @@ where per-round dispatch cost is ~free.  Structural op counts
 (``lowered_window_calls``, ``lowered_resolve_scatters_on`` — round 10's
 Pallas-kernel fusion evidence) flag on ANY increase: the window phase
 fragmenting out of its single custom-call is a 1 -> N event, invisible
-to every throughput metric on CPU.  Each metric chains to the most
-recent prior row that HAS it, so probe/skipped rows can't mask a later
-regression.
+to every throughput metric on CPU.  Service rows chain two more:
+``cache_hit_ratio`` (higher is better, drop flags) and
+``p99_first_result_s`` (serving-latency tail: LOWER is better, a >20%
+GROWTH flags).  Each metric chains to the most recent prior row that
+HAS it, so probe/skipped rows can't mask a later regression.
 
 Sweep rows ingest like bench rows: a ``graphite-tpu sweep -o`` output
 or a bench ``radix8_sweep8`` detail row carries ``variants`` +
@@ -189,6 +191,34 @@ def ff_quanta_frac(row: dict):
     return f if f > 0 else None
 
 
+def p99_first_result_s(row: dict):
+    """Serving-latency tail (ISSUE 17): p99 submit-to-first-result
+    seconds of a sweep-service row (bench radix8_service and
+    ``sweep --serve`` outputs carry it directly).  LOWER is better —
+    a growth beyond the threshold flags.  None for non-service rows or
+    passes with no simulated tickets."""
+    v = row.get("p99_first_result_s")
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
+def cache_hit_ratio(row: dict):
+    """Cache effectiveness of a sweep-service row: hits over lookups,
+    in (0, 1].  Chains like a throughput metric — a >threshold drop
+    means identical re-submissions stopped being served from
+    results_db (key drift, schema change, cold store).  None when the
+    row did no cache lookups."""
+    v = row.get("cache_hit_ratio")
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
 def _count_metric(key):
     """Lower-is-better structural count (e.g. ``lowered_window_calls``:
     pallas_call sites in the lowered window round — 1 when the phase is
@@ -240,7 +270,10 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
                # like events/round — a >threshold drop vs the most
                # recent prior comparable row flags even though host
                # timing on a CPU container never would.
-               ("ff-quanta-frac", ff_quanta_frac))
+               ("ff-quanta-frac", ff_quanta_frac),
+               # ISSUE 17: cache-hit ratio chains higher-is-better like
+               # the throughputs.
+               ("cache-hit-ratio", cache_hit_ratio))
     warnings = []
     for name, fn in metrics:
         new = fn(row)
@@ -261,6 +294,28 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
                 f"REGRESSION {workload}: {new:.1f} {name} vs prior "
                 f"{old:.1f} (-{drop:.0f}% > {threshold_pct:.0f}% "
                 f"threshold)")
+    # ISSUE 17 serving-latency tail: LOWER is better, so the flag fires
+    # on GROWTH beyond the threshold (mirror image of the throughput
+    # chains — same most-recent-prior-row-that-has-it chaining).
+    for name, fn in (("p99-first-result-s", p99_first_result_s),):
+        new = fn(row)
+        if new is None:
+            continue
+        old = None
+        for (raw,) in db.execute(
+                "SELECT raw_json FROM runs WHERE workload = ? "
+                "ORDER BY ts DESC, id DESC", (workload,)):
+            old = fn(json.loads(raw))
+            if old is not None:
+                break
+        if old is None or old <= 0:
+            continue
+        rise = (new - old) / old * 100.0
+        if rise > threshold_pct:
+            warnings.append(
+                f"REGRESSION {workload}: {new:.3f} {name} vs prior "
+                f"{old:.3f} (+{rise:.0f}% > {threshold_pct:.0f}% "
+                f"threshold; serving latency grew)")
     # Structural counts: lower is better, exact — ANY increase over the
     # most recent prior row carrying the metric flags (the window phase
     # fragmenting out of its one custom-call is a 1 -> N event, not a

@@ -527,6 +527,107 @@ if [ $recover_rc -ne 0 ]; then
     fail=1
 fi
 
+# Service-observability smoke gate (ISSUE 17 CI satellite): serve one
+# ticket to seed the results_db, then serve TWO tickets (the seeded one
+# + a fresh one) in a second process with --metrics-path.  The written
+# Prometheus exposition must PARSE, count exactly 2 ticket_latency_s
+# observations and exactly 1 cache_hits_total, and the serve output +
+# journal must carry the streaming evidence (p99_first_result_s,
+# first_result records preceding done records) plus a working `status`
+# subcommand over the journal.
+obs_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import json, os, shutil, subprocess, sys, tempfile
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from graphite_tpu.events import synth
+from graphite_tpu.obs.registry import parse_exposition
+
+tmp = tempfile.mkdtemp()
+trace_path = os.path.join(tmp, "t.npz")
+synth.gen_radix(2, keys_per_tile=16, radix=8, seed=1).save(trace_path)
+db = os.path.join(tmp, "results.db")
+metrics = os.path.join(tmp, "metrics.prom")
+
+BASE = [sys.executable, "-c",
+        "from graphite_tpu.cli import main; raise SystemExit(main())",
+        "--general/total_cores=2"]
+
+def run(args):
+    env = dict(os.environ)
+    env.pop("GRAPHITE_FAULTS", None)
+    r = subprocess.run(BASE + args, env=env, cwd=os.getcwd(),
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (args, r.returncode, r.stderr[-2000:])
+    return r
+
+# Leg 1: seed the cache with one design point (its own journal).
+run(["sweep", "--trace", trace_path, "--serve",
+     "--journal", os.path.join(tmp, "j1"), "--db", db,
+     "--sweep", "dram/latency=90"])
+
+# Leg 2: fresh process serves 2 tickets — one cache hit, one simulated
+# — with the metrics exposition on.
+out2 = os.path.join(tmp, "serve2.json")
+run(["sweep", "--trace", trace_path, "--serve",
+     "--journal", os.path.join(tmp, "j2"), "--db", db,
+     "--metrics-path", metrics, "-o", out2,
+     "--sweep", "dram/latency=90,120"])
+
+parsed = parse_exposition(open(metrics).read())   # must PARSE
+assert parsed["ticket_latency_s_count"] == [({}, 2.0)], \
+    parsed.get("ticket_latency_s_count")
+assert parsed["cache_hits_total"] == [({}, 1.0)], \
+    parsed.get("cache_hits_total")
+assert parsed["variants_served_total"] == [({}, 2.0)]
+states = {l["state"]: v for l, v in parsed["tickets_in_state"]}
+assert states.get("done") == 2.0, states
+
+res = json.load(open(out2))
+assert res["variants"] == 2 and res["variants_per_sec"] > 0, res
+assert res["p99_first_result_s"] and res["p99_first_result_s"] > 0
+assert res["cache_hit_ratio"] == 0.5, res["cache_hit_ratio"]
+
+# Streaming evidence in the journal: the simulated ticket's
+# first_result record precedes every done record.
+from graphite_tpu.sweep.service import read_journal
+recs = read_journal(os.path.join(tmp, "j2"))
+fr = [r["seq"] for r in recs if r["event"] == "first_result"]
+dn = [r["seq"] for r in recs if r["event"] == "done"]
+assert fr and dn and min(fr) < min(dn), (fr, dn)
+
+# `status` subcommand folds the journal (no trace needed).
+st = run(["status", "--journal", os.path.join(tmp, "j2"), "--json"])
+sj = json.loads(st.stdout)
+assert sj["counts"]["done"] == 2 and sj["open"] == 0, sj["counts"]
+assert sj["p99_first_result_s"] is not None
+
+# results_db ingest + latency regression flag: re-ingest the same row
+# with a 10x p99 and expect the REGRESSION line.
+sys.path.insert(0, os.path.join(os.getcwd(), "tools"))
+import results_db
+rdb = results_db.open_db(os.path.join(tmp, "reg.db"))
+base_row = {"p99_first_result_s": res["p99_first_result_s"],
+            "cache_hit_ratio": res["cache_hit_ratio"],
+            "variants": res["variants"],
+            "host_seconds": res["host_seconds"]}
+assert results_db.check_regression(rdb, "svc", base_row) is None
+results_db.add_run(rdb, "svc", base_row)
+slow = dict(base_row)
+slow["p99_first_result_s"] = base_row["p99_first_result_s"] * 10
+warn = results_db.check_regression(rdb, "svc", slow)
+assert warn and "p99-first-result-s" in warn, warn
+shutil.rmtree(tmp)
+print("SERVICE OBSERVABILITY SMOKE OK (2 tickets: 1 simulated + 1 "
+      "cache hit; exposition parsed, first_result precedes done, "
+      "latency regression flag fires)")
+PYEOF
+)
+obs_rc=$?
+echo "$obs_out" | tail -3
+if [ $obs_rc -ne 0 ]; then
+    echo "SERVICE OBSERVABILITY GATE FAILED"
+    fail=1
+fi
+
 if [ $fail -eq 0 ]; then
     echo "ALL MODULES PASSED"
 else
