@@ -32,7 +32,13 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 25  # v25: fault-tolerant sweep service — batched
+_SCHEMA_VERSION = 26  # v26: resident tile-sharded runs (tpu/shard_state)
+#   — checkpoints stay whole-array (the flatten seam gathers sharded
+#   leaves via np.asarray, the ONLY full-T materialization point of a
+#   resident run), and restore re-places tile-sharded in
+#   sim.restore_checkpoint; the bump rejects pre-resident files whose
+#   phase-counter semantics predate the routed-resolve counters;
+#   v25: fault-tolerant sweep service — batched
 #   [V]-leading SweepSimulator checkpoints (save/load_sweep_checkpoint,
 #   __meta_variants) and atomic tmp+fsync+rename writes;
 #   v24: round-12 adaptive-fidelity fast-forward —

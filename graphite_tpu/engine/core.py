@@ -270,7 +270,8 @@ def _fast_forward_guarded(params: SimParams, vp: VariantParams,
 
 
 def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
-                  trace: TraceArrays, width: int = None) -> SimState:
+                  trace: TraceArrays, width: int = None,
+                  tile_ids=None) -> SimState:
     """Retire the leading run of simple events in each tile's [K] window.
 
     This function is the gather/apply shell: it assembles the window
@@ -335,7 +336,8 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
     S_ids = st.spawned_at.shape[0]
     wi = kwindow.WindowIn(
         meta=meta, addr=addr, valid_ev=valid_ev, tile_active=tile_active,
-        tile_ids=jnp.arange(T, dtype=jnp.int32),
+        tile_ids=(jnp.arange(T, dtype=jnp.int32)
+                  if tile_ids is None else tile_ids),
         clock=st.clock, period_ps=st.period_ps, bp_table=st.bp_table,
         l1i_word=st.l1i.word, l1i_rr=st.l1i.rr_ptr,
         l1d_word=st.l1d.word, l1d_rr=st.l1d.rr_ptr,
@@ -355,7 +357,11 @@ def _block_retire(params: SimParams, vp: VariantParams, st: SimState,
     # Sharded dispatch (tpu/tile_shards > 1, inside the quantum
     # program's shard_map): each device walks its own T/S tile slice and
     # all_gathers the results — the whole walk is shard-local compute.
-    if params.tile_shards > 1:
+    # Resident mode (shard_state = "resident") never takes this branch:
+    # its caller already runs shard-local with T = T/S operands and an
+    # explicit tile_ids slice, so the plain path below IS its program.
+    if params.tile_shards > 1 and params.shard_state == "replicated" \
+            and tile_ids is None:
         out = kwindow.run_window_sharded(params, vp, wi, S_ids,
                                          kdispatch.window_mode(params))
     else:

@@ -246,8 +246,14 @@ def jaxpr_op_counts(fn, *args) -> dict:
     # psum/psum2 and reduce_scatter naming variants across jax versions).
     # The round-11 sharding gates assert the shard-LOCAL window phase has
     # zero of these and the whole sharded step a small bounded count.
+    # Each family is ALSO counted under its own key (zero-initialized so
+    # absent families read 0): the round-15 resident gate asserts the
+    # per-family budget — zero all_gathers, a bounded all_to_all count,
+    # exactly one pmin — not just the total.
     _COLLECTIVES = ("all_gather", "psum", "pmin", "pmax", "all_to_all",
                     "ppermute", "reduce_scatter", "pbroadcast")
+    for fam in _COLLECTIVES:
+        counts[fam] = 0
 
     def visit(jaxpr):
         for eqn in jaxpr.eqns:
@@ -265,6 +271,10 @@ def jaxpr_op_counts(fn, *args) -> dict:
                 counts["fori_or_scan"] += 1
             if prim.startswith(_COLLECTIVES):
                 counts["collective"] += 1
+                for fam in _COLLECTIVES:
+                    if prim.startswith(fam):
+                        counts[fam] += 1
+                        break
             # Recurse into sub-jaxprs (loop/cond/pjit bodies ride in
             # eqn params) — pallas_call kernel jaxprs are deliberately
             # NOT descended into: their ops are fused inside one call.

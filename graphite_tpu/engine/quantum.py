@@ -433,6 +433,10 @@ def megastep(params: SimParams, state: SimState,
     replicated, the window walk slices to per-shard tiles inside
     (kernels/window.run_window_sharded), and the quantum barrier is a
     pmin.  At 1 the wrapper is the identity — today's program."""
+    if params.shard_state == "resident":
+        raise ValueError("tpu/shard_state=resident runs through "
+                         "engine/resident.megarun, not the replicated "
+                         "quantum program")
     if params.tile_shards <= 1 and state_donation_enabled():
         return _megastep_donate(params, state, trace)
     return _megastep_nodonate(params, state, trace)
@@ -520,6 +524,10 @@ def megarun(params: SimParams, state: SimState, trace: TraceArrays,
     State donation is opt-in and 1-only (see the note above
     ``state_donation_enabled``).
     """
+    if params.shard_state == "resident":
+        raise ValueError("tpu/shard_state=resident runs through "
+                         "engine/resident.megarun, not the replicated "
+                         "quantum program")
     if params.tile_shards <= 1 and state_donation_enabled():
         return _megarun_donate(params, state, trace, max_quanta)
     return _megarun_nodonate(params, state, trace, max_quanta)

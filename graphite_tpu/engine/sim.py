@@ -432,8 +432,13 @@ class Simulator:
             # cost is attributable in the exported host track.
             with span("sim.compile+window" if first_dispatch
                       else "sim.window", quanta=window * qps):
-                self.state = megarun(self.params, self.state, self.trace,
-                                     window * qps)
+                if self.params.shard_state == "resident":
+                    from graphite_tpu.engine import resident
+                    self.state = resident.megarun(
+                        self.params, self.state, self.trace, window * qps)
+                else:
+                    self.state = megarun(self.params, self.state,
+                                         self.trace, window * qps)
                 done, cursor_sum, clock_sum, quanta = jax.device_get(
                     (self.state.all_done(), self.state.cursor.sum(),
                      self.state.clock.sum(), self.state.ctr_quantum))
@@ -477,6 +482,15 @@ class Simulator:
     def restore_checkpoint(self, path: str) -> None:
         from graphite_tpu.engine.checkpoint import load_checkpoint
         self.state, self.steps = load_checkpoint(path, self.params)
+        if self.params.shard_state == "resident" \
+                and self.params.tile_shards > 1:
+            # Checkpoints are whole-array (the save seam gathers); a
+            # resident run re-places its restored state tile-sharded.
+            from graphite_tpu.parallel import mesh as meshmod
+            mesh = meshmod.make_mesh(
+                jax.devices()[:self.params.tile_shards])
+            self.state = meshmod.resident_place(
+                self.state, mesh, self.params.num_tiles)
 
 
 def run_simulation(params: SimParams, trace: Trace,

@@ -148,3 +148,60 @@ def shard_pytree(tree: Any, mesh: Mesh, num_tiles: int) -> Any:
         return jax.device_put(leaf, spec(name, leaf))
 
     return jax.tree_util.tree_map_with_path(place, tree)
+
+
+# ------------------------------------------------- round 15: resident mode
+# (tpu/shard_state = "resident", engine/resident.py): state leaves stay
+# SHARDED along the tile axis for the whole run, so shard_map in/out specs
+# are per-leaf PartitionSpecs instead of the replicated P() above.  The
+# field->axis table is the replicated one plus dram_qacc (the [6, T] DRAM
+# moment accumulators never cross the replicated path's shard_map seam, so
+# the round-11 table omits them).
+_RESIDENT_EXTRA_AXES = {"dram_qacc": 1}
+
+
+def _path_name(path) -> str:
+    for p in reversed(path):
+        if hasattr(p, "name"):
+            return p.name
+    return ""
+
+
+def resident_spec_for_shape(name: str, shape, num_tiles: int):
+    """PartitionSpec of one leaf SHAPE under resident sharding: tile axis
+    split over the mesh, everything else (scalars, sync objects,
+    zero-size compiled-out arrays) replicated."""
+    ax = _TILE_AXIS_BY_FIELD.get(name, _RESIDENT_EXTRA_AXES.get(name, 0))
+    ok = len(shape) > ax and (
+        shape[ax] == num_tiles
+        or (name in _TILE_MAJOR_FLAT and shape[ax] % num_tiles == 0
+            and shape[ax] > 0))
+    if ok:
+        return P(*([None] * ax + [TILE_AXIS]))
+    return P()
+
+
+def resident_spec_for(name: str, leaf: Any, num_tiles: int):
+    """PartitionSpec of one leaf under resident sharding."""
+    return resident_spec_for_shape(name, np.shape(leaf), num_tiles)
+
+
+def resident_specs(tree: Any, num_tiles: int) -> Any:
+    """Matching pytree of PartitionSpecs for ``tree`` (SimState /
+    TraceArrays / any container of named leaves) under resident
+    sharding — the in_specs/out_specs form shard_map wants."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: resident_spec_for(_path_name(path), leaf,
+                                             num_tiles),
+        tree)
+
+
+def resident_place(tree: Any, mesh: Mesh, num_tiles: int) -> Any:
+    """device_put a pytree onto the mesh with resident (tile-sharded)
+    placement — the driver-entry placement for resident runs."""
+
+    def place(path, leaf):
+        spec = resident_spec_for(_path_name(path), leaf, num_tiles)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, tree)

@@ -114,6 +114,17 @@ def _pallas_kernels(value: str) -> str:
     return value
 
 
+_SHARD_STATE_MODES = ("replicated", "resident")
+
+
+def _shard_state(value: str) -> str:
+    if value not in _SHARD_STATE_MODES:
+        raise ConfigError(
+            f"tpu/shard_state must be one of {_SHARD_STATE_MODES}: "
+            f"{value!r}")
+    return value
+
+
 def _tile_shards(raw: str, num_tiles: int) -> int:
     """Resolve ``tpu/tile_shards`` to a concrete shard count.
 
@@ -811,6 +822,31 @@ class SimParams:
     # "auto" (largest divisor of T the device set carries) or an
     # explicit divisor of T; the field always holds the resolved int.
     tile_shards: int
+    # Round-15 resident sharding (engine/resident.py): "replicated" is
+    # the round-11 program above — state replicated on every device, the
+    # hot phase shard_mapped, outputs all_gathered back each step.
+    # "resident" keeps every T-leading SimState leaf SHARDED along the
+    # tile axis for the whole run: the window walk and local advance run
+    # shard-local with no output gathers, and the resolve/chain phase is
+    # re-expressed as home-binned routing (chain heads bucketed by
+    # dense.home_fold home shard, all_to_all-routed to their home
+    # device, priced against home-resident directory state, routed
+    # back).  Per-device resident HBM drops from O(T) to O(T/S) and the
+    # 13 per-step all_gathers become <=2 fixed-capacity all_to_alls
+    # plus the existing pmin barrier.  The resident program is its own
+    # family: its contract is shard-count invariance (resident S=8 ==
+    # resident S=1, bit for bit), checked in tests/test_sharding.py.
+    # Only a validated config subset lowers (engine/resident.py
+    # validate_params); anything else raises ConfigError up front.
+    shard_state: str
+    # Per-(source shard, dest shard) record capacity of the resident
+    # routing all_to_all.  0 ("auto") sizes it at 2*T/S — structurally
+    # never overflowing; smaller explicit values shrink the routed
+    # payload, and a step whose inbound heads exceed the budget takes
+    # the host-side overflow spill (value-identical, counted in
+    # obs routing_overflows_total) so correctness never depends on the
+    # heuristic.
+    route_capacity: int
     channel_depth: int
     # Captured-trace replay: a recorded COND_WAIT provably consumed SOME
     # signal in the native run, but simulated retiming can invert the
@@ -1105,6 +1141,11 @@ class SimParams:
                 cfg.get_str("tpu/pallas_kernels", "auto")),
             tile_shards=_tile_shards(
                 cfg.get_str("tpu/tile_shards", "1"), T),
+            shard_state=_shard_state(
+                cfg.get_str("tpu/shard_state", "replicated")),
+            route_capacity=_nonneg(
+                cfg.get_int("tpu/route_capacity", 0),
+                "tpu/route_capacity"),
             channel_depth=cfg.get_int("tpu/channel_depth", 16),
             cond_replay=cfg.get_bool("tpu/cond_replay", False),
             fast_forward=_fast_forward(

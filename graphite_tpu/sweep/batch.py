@@ -96,7 +96,16 @@ def sweep_megarun(canon: SimParams, bstate, bvp, trace: TraceArrays,
     OUTSIDE, vmap INSIDE (parallel/mesh.shard_wrap wraps the vmapped
     body).  The engine's slicing code is written against unbatched tile
     axes, so vmap lifts it over the [V] lane axis while the mesh axis
-    splits tiles — V variants x T/S tiles per device in ONE program."""
+    splits tiles — V variants x T/S tiles per device in ONE program.
+
+    With ``tpu/shard_state = resident`` the composition flips inside
+    out — shard_map OUTSIDE a vmapped shard-local body, state leaves
+    sharded along tiles for the whole run — and the host-driven resident
+    sweep driver (engine/resident.sweep_megarun) takes over."""
+    if canon.shard_state == "resident":
+        from graphite_tpu.engine import resident
+        return resident.sweep_megarun(canon, bstate, trace, bvp,
+                                      max_quanta)
     from graphite_tpu.engine.quantum import state_donation_enabled
     if canon.tile_shards <= 1 and state_donation_enabled():
         return _sweep_donate(canon, bstate, bvp, trace, max_quanta)

@@ -104,6 +104,8 @@ STRUCTURAL_LEAVES = frozenset({
     "rounds_per_quantum", "quanta_per_step", "max_inv_fanout_per_round",
     "miss_chain", "max_resolve_rounds", "channel_depth",
     "tile_shards",                # selects the sharded vs solo program
+    "shard_state",                # replicated vs resident program family
+    "route_capacity",             # sizes the resident routing buffers
     "fast_forward",               # compiles the analytic leg in or out
 } | {f"{c}.{f}" for c in ("l1i", "l1d", "l2") for f in _CACHE_STRUCT}
   | {f"{n}.atac.{f}" for n in ("net_user", "net_memory")

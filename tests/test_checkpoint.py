@@ -269,3 +269,82 @@ def test_sweep_checkpoint_guards(tmp_path):
                               num_streams=trace.num_tiles)
     with pytest.raises(ValueError, match="sweep"):
         load_checkpoint(ck, variants[0])
+
+
+# ---------------------------------------------------------------- round 15
+# Resident tile-sharded runs (tpu/shard_state=resident): checkpoints stay
+# whole-array .npz (the flatten seam gathers sharded leaves — the only
+# full-T materialization point of a resident run) and restore re-places
+# them onto the mesh, so stop/resume is bit-identical at any shard count.
+
+_RESIDENT_PARAMS = None
+
+
+def _resident_params():
+    """One shared params object: resident program caches key on
+    id(params), so every Simulator in this section reuses compiles."""
+    global _RESIDENT_PARAMS
+    if _RESIDENT_PARAMS is None:
+        cfg = load_config()
+        cfg.set("general/total_cores", 16)
+        cfg.set("tpu/tile_shards", "8")
+        cfg.set("tpu/shard_state", "resident")
+        cfg.set("tpu/block_events", "4")
+        cfg.set("tpu/quanta_per_step", "1")
+        cfg.set("tpu/miss_chain", "8")
+        cfg.set("tpu/window_cache", "false")
+        cfg.set("dram/queue_model/enabled", "false")
+        _RESIDENT_PARAMS = SimParams.from_config(cfg)
+    return _RESIDENT_PARAMS
+
+
+@pytest.mark.slow   # three resident megaruns share one compile set
+def test_resident_resume_bit_identical(tmp_path):
+    """Stop a resident run mid-flight, checkpoint, restore (which
+    re-places the whole-array leaves tile-sharded), finish — every
+    state leaf equals the uninterrupted run's."""
+    from graphite_tpu.engine.checkpoint import _flatten_with_paths
+
+    params = _resident_params()
+    trace = synth.gen_migratory(16, lines=4, rounds=2)
+
+    full = Simulator(params, trace)
+    full.run()
+
+    half = Simulator(params, trace)
+    half.run(max_steps=2)
+    ck = str(tmp_path / "resident.npz")
+    half.save_checkpoint(ck)
+
+    resumed = Simulator(params, trace)
+    resumed.restore_checkpoint(ck)
+    assert resumed.steps == 2
+    resumed.run()
+
+    a, _ = _flatten_with_paths(full.state)
+    b, _ = _flatten_with_paths(resumed.state)
+    for key in a:
+        assert np.array_equal(a[key], b[key]), key
+
+
+def test_resident_old_schema_rejected(tmp_path):
+    """Pre-resident checkpoints (schema < 26) are rejected with the
+    schema ValueError, not silently reinterpreted: the routed-resolve
+    phase counters changed semantics under the v26 bump."""
+    params = _resident_params()
+    trace = synth.gen_migratory(16, lines=4, rounds=2)
+    # Save the INITIAL state — schema enforcement needs no simulation,
+    # and skipping the run keeps this in the quick tier (no compiles).
+    sim = Simulator(params, trace)
+    ck = str(tmp_path / "new.npz")
+    sim.save_checkpoint(ck)
+
+    with np.load(ck) as z:
+        doctored = {k: z[k] for k in z.files}
+    doctored["__meta_schema"] = np.int64(25)
+    old = str(tmp_path / "old.npz")
+    with open(old, "wb") as f:
+        np.savez(f, **doctored)
+
+    with pytest.raises(ValueError, match="schema 25"):
+        Simulator(params, trace).restore_checkpoint(old)

@@ -246,3 +246,145 @@ def test_tile_shards_sweep_identity():
     sw1 = SweepSimulator(variants(1), trace)
     sw1.run()
     _assert_states_equal(sw8.bstate, sw1.bstate)
+
+
+# ---------------------------------------------------------------- round 15
+# Resident tile-sharded state (tpu/shard_state=resident): every T-leading
+# leaf stays sharded along T for the whole run, resolve is home-routed
+# over fixed-capacity all_to_alls, and the contract is shard-count
+# INVARIANCE — resident S=8 equals resident S=1 bit-for-bit, including
+# through both host spill paths (capacity overflow replay and the
+# stuck-head gather through the replicated resolver).
+
+from graphite_tpu.engine import resident
+from graphite_tpu.params import ConfigError
+
+# One params object per cell: resident._CACHE keys compiled programs on
+# id(params), so sharing these across tests skips ~20s recompiles each.
+_RP_CACHE = {}
+
+
+def _rparams(tiles: int, shards: int, **sets):
+    key = (tiles, shards, tuple(sorted(sets.items())))
+    if key not in _RP_CACHE:
+        base = dict(tpu__shard_state="resident",
+                    tpu__block_events="4",
+                    tpu__quanta_per_step="1",
+                    tpu__miss_chain="8",
+                    tpu__window_cache="false",
+                    dram__queue_model__enabled="false")
+        base.update(sets)
+        _RP_CACHE[key] = _params(tiles, shards, **base)
+    return _RP_CACHE[key]
+
+
+def _resident_pair(trace, tiles: int, **sets):
+    """Run the SAME trace resident at S=8 and S=1; return both sims."""
+    r8 = Simulator(_rparams(tiles, 8, **sets), trace)
+    r8.run()
+    r1 = Simulator(_rparams(tiles, 1, **sets), trace)
+    r1.run()
+    return r8, r1
+
+
+@pytest.mark.slow   # two resident compile sets (~1 min each on 1 core)
+def test_resident_bit_identity_migratory():
+    """Line-migration traffic (E->owner-change chains, the worst case
+    for home routing) at S=8 equals S=1 on every leaf."""
+    trace = synth.gen_migratory(16, lines=4, rounds=2)
+    r8, r1 = _resident_pair(trace, 16)
+    assert bool(np.asarray(r8.state.all_done()))
+    _assert_states_equal(r8.state, r1.state)
+
+
+@pytest.mark.slow   # two more compile sets (route_capacity is structural)
+def test_resident_overflow_spill_identity():
+    """route_capacity=1 forces the capacity-overflow host spill to fire
+    (capped result discarded, sub-round replayed uncapped on one
+    device) — and the final state is STILL shard-count invariant, so
+    correctness never depends on the capacity heuristic."""
+    trace = synth.gen_migratory(16, lines=4, rounds=2)
+    before = resident._DEBUG_STATS["overflow_spills"]
+    r8, r1 = _resident_pair(trace, 16, tpu__route_capacity="1")
+    assert resident._DEBUG_STATS["overflow_spills"] > before, \
+        "spill path never fired — the test is not exercising overflow"
+    _assert_states_equal(r8.state, r1.state)
+
+
+def test_resident_lowered_census():
+    """The tentpole's collective budget, counted on the lowered step:
+    ZERO full-T all_gathers (the replicated path has 13), at most two
+    all_to_alls (request + response legs), exactly one pmin (the
+    quantum barrier)."""
+    params = _rparams(16, 8)
+    trace = synth.gen_migratory(16, lines=4, rounds=2)
+    tarrays = TraceArrays.from_trace(trace)
+    counts = resident.lowered_quantum_collectives(
+        params, make_state(params), tarrays)
+    assert counts["all_gather"] == 0, counts
+    assert counts["all_to_all"] <= 2, counts
+    assert counts["pmin"] == 1, counts
+
+
+def test_resident_quantum_guard():
+    """The replicated quantum program refuses resident params loudly
+    instead of silently running with replicated semantics."""
+    params = _rparams(16, 8)
+    trace = synth.gen_migratory(16, lines=4, rounds=2)
+    tarrays = TraceArrays.from_trace(trace)
+    with pytest.raises(ValueError, match="resident"):
+        megastep(params, make_state(params), tarrays)
+
+
+def test_resident_validated_subset_rejects():
+    """Configs outside the resident subset fail at validation time
+    (ConfigError naming the mode), not as a silent per-round spill:
+    window cache on, and traces with sync ops (radix uses barriers)."""
+    bad = _params(16, 8, tpu__shard_state="resident",
+                  tpu__miss_chain="8",
+                  dram__queue_model__enabled="false")  # window_cache on
+    trace = synth.gen_migratory(16, lines=4, rounds=2)
+    with pytest.raises(ConfigError, match="resident"):
+        resident.megarun(bad, make_state(bad),
+                         TraceArrays.from_trace(trace), 1)
+    barriers = synth.gen_radix(num_tiles=16, keys_per_tile=8, radix=16)
+    good = _rparams(16, 8)
+    with pytest.raises(ConfigError, match="resident"):
+        resident.megarun(good, make_state(good),
+                         TraceArrays.from_trace(barriers), 1)
+
+
+@pytest.mark.slow   # directory pressure run: ~97 stuck spills per side
+def test_resident_stuck_spill_identity():
+    """A 1-set-per-home-tile directory forces live-sharer victims the
+    routed pass cannot price; the stuck-head gather through the
+    replicated resolver fires and the result stays S-invariant."""
+    trace = synth.gen_migratory(16, lines=64, rounds=2)
+    before = resident._DEBUG_STATS["stuck_spills"]
+    r8, r1 = _resident_pair(trace, 16,
+                            dram_directory__total_entries="2",
+                            dram_directory__associativity="2")
+    assert resident._DEBUG_STATS["stuck_spills"] > before, \
+        "stuck-spill path never fired — not enough directory pressure"
+    _assert_states_equal(r8.state, r1.state)
+
+
+@pytest.mark.slow   # batched resident programs: extra compile set
+def test_resident_sweep_identity():
+    """vmap x shard_map composition for resident lanes: a sharded
+    resident sweep's lanes equal the unsharded resident sweep's lanes,
+    leaf for leaf (the sweep path routes with a structurally
+    overflow-free capacity, so no spill nondeterminism)."""
+    from graphite_tpu.sweep.batch import SweepSimulator
+
+    trace = synth.gen_migratory(16, lines=4, rounds=2)
+
+    def variants(shards):
+        return [_rparams(16, shards, l2__data_access_time=str(lat))
+                for lat in (8, 10, 12)]
+
+    sw8 = SweepSimulator(variants(8), trace)
+    sw8.run()
+    sw1 = SweepSimulator(variants(1), trace)
+    sw1.run()
+    _assert_states_equal(sw8.bstate, sw1.bstate)

@@ -248,6 +248,20 @@ COUNT_METRICS = (
     # leaked into a phase that was shard-local.
     ("lowered_step_collectives",
      _count_metric("lowered_step_collectives")),
+    # Round 15: the same census keyed by shard strategy.  The replicated
+    # step's budget is the window-output all_gathers + pmin; the
+    # RESIDENT step's is two fixed-capacity all_to_alls per chain
+    # iteration + pmin and ZERO all_gathers — a resident row growing an
+    # all_gather (or a third all_to_all) means a full-T materialization
+    # leaked back into the steady state.
+    ("lowered_step_collectives_replicated",
+     _count_metric("lowered_step_collectives_replicated")),
+    ("lowered_step_collectives_resident",
+     _count_metric("lowered_step_collectives_resident")),
+    ("lowered_step_all_gathers_resident",
+     _count_metric("lowered_step_all_gathers_resident")),
+    ("lowered_step_all_to_alls_resident",
+     _count_metric("lowered_step_all_to_alls_resident")),
 )
 
 

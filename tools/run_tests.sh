@@ -392,6 +392,85 @@ if [ $shard_rc -ne 0 ]; then
     fail=1
 fi
 
+# Resident routed-resolve gate (ISSUE 19 CI satellite): the lowered
+# resident quantum step (tpu/shard_state=resident over 8 virtual
+# devices) must contain ZERO full-T all_gathers, at most TWO
+# fixed-capacity all_to_alls (request + response routing legs) and
+# exactly ONE pmin (the quantum barrier).  Both censuses are recorded
+# keyed by shard strategy in results_db, whose COUNT_METRICS flag must
+# fire if a resident row ever grows a collective — a full-T
+# materialization leaking back into the steady state is a 0 -> 1
+# event, not a drift.
+resident_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import os, sys, tempfile
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+from graphite_tpu.config import load_config
+from graphite_tpu.engine import quantum, resident
+from graphite_tpu.engine.kernels import dispatch
+from graphite_tpu.engine.state import TraceArrays, make_state
+from graphite_tpu.events import synth
+from graphite_tpu.params import SimParams
+
+def params(shard_state):
+    cfg = load_config()
+    cfg.set("general/total_cores", 16)
+    cfg.set("tpu/tile_shards", "8")
+    cfg.set("tpu/shard_state", shard_state)
+    if shard_state == "resident":
+        cfg.set("tpu/block_events", "4")
+        cfg.set("tpu/quanta_per_step", "1")
+        cfg.set("tpu/miss_chain", "8")
+        cfg.set("tpu/window_cache", "false")
+        cfg.set("dram/queue_model/enabled", "false")
+    return SimParams.from_config(cfg)
+
+trace = synth.gen_migratory(16, lines=4, rounds=2)
+tarrays = TraceArrays.from_trace(trace)
+
+pres = params("resident")
+cres = resident.lowered_quantum_collectives(
+    pres, make_state(pres), tarrays)
+assert cres["all_gather"] == 0, cres
+assert cres["all_to_all"] <= 2, cres
+assert cres["pmin"] == 1, cres
+
+prep = params("replicated")
+crep = dispatch.jaxpr_op_counts(
+    lambda s, t: quantum.megastep(prep, s, t),
+    make_state(prep), tarrays)
+assert crep["all_gather"] > 0, crep   # what the resident step deleted
+
+sys.path.insert(0, os.path.join(os.getcwd(), "tools"))
+import results_db
+tmp = tempfile.mkdtemp()
+rdb = results_db.open_db(os.path.join(tmp, "census.db"))
+row = {"lowered_step_collectives_replicated": crep["collective"],
+       "lowered_step_collectives_resident": cres["collective"],
+       "lowered_step_all_gathers_resident": cres["all_gather"],
+       "lowered_step_all_to_alls_resident": cres["all_to_all"]}
+assert results_db.check_regression(rdb, "resident_census", row) is None
+results_db.add_run(rdb, "resident_census", row)
+grown = dict(row)
+grown["lowered_step_all_to_alls_resident"] += 1
+warn = results_db.check_regression(rdb, "resident_census", grown)
+assert warn and "lowered_step_all_to_alls_resident" in warn, warn
+print(f"RESIDENT ROUTED-RESOLVE GATE OK (resident step: "
+      f"{cres['all_gather']} all_gathers / {cres['all_to_all']} "
+      f"all_to_alls / {cres['pmin']} pmin, {cres['collective']} "
+      f"collectives total; replicated step: {crep['all_gather']} "
+      f"all_gathers; census regression flag fires)")
+PYEOF
+)
+resident_rc=$?
+echo "$resident_out" | tail -3
+if [ $resident_rc -ne 0 ]; then
+    echo "RESIDENT ROUTED-RESOLVE GATE FAILED"
+    fail=1
+fi
+
 # Fast-forward smoke gate (ISSUE 14 CI satellite): the adaptive-fidelity
 # analytic leg on the tiny radix-8 trace must (1) leave fast_forward=0
 # EXACTLY on the committed golden fixture (the leg is compiled in only

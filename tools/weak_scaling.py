@@ -18,11 +18,21 @@ The summary is results_db-ingestible: one ``weak_scaling_shard{S}_T{T}``
 workload per cell, each carrying ``quanta_per_s`` (tools/results_db.py
 ``add`` flags >20% drops per cell — like compares with like).
 
+Round 15 adds RESIDENT rows (``tpu/shard_state = resident``): the same
+matrix with state tile-sharded for the whole run and resolve home-routed
+over two fixed-capacity all_to_alls, on a migratory chain workload.
+Every row (both strategies) carries ``modeled_step_bytes_moved`` — the
+modeled cross-device bytes of one quantum step's collectives — and
+resident rows add ``resident_state_bytes_per_device`` (the O(T/S)
+footprint claim in measurable form).
+
     python tools/weak_scaling.py                     # full curve
     python tools/weak_scaling.py --shards 1,8 --tiles 1024   # subset
+    python tools/weak_scaling.py --no-resident       # replicated only
     python tools/weak_scaling.py --quanta 24 --warm 8        # window
     python tools/weak_scaling.py --bench-shard8      # bench.py's A/B row
     python tools/weak_scaling.py --leg S T           # internal (one cell)
+    python tools/weak_scaling.py --leg-resident S T  # one resident cell
 
 Env: ``GRAPHITE_WEAK_SCALING_BUDGET_S`` — wall-clock budget (default
 3600); cells starting past it emit ``kind=skipped_budget`` rows instead
@@ -56,6 +66,25 @@ def _params(tiles: int, shards: int):
     return SimParams.from_config(cfg)
 
 
+def _params_resident(tiles: int, shards: int):
+    """Round-15 resident cells: tile-sharded state, home-routed resolve
+    (the validated resident subset — chain engine on, window cache and
+    DRAM queue model off)."""
+    from graphite_tpu.config import load_config
+    from graphite_tpu.params import SimParams
+
+    cfg = load_config()
+    cfg.set("general/total_cores", tiles)
+    cfg.set("tpu/tile_shards", str(shards))
+    cfg.set("tpu/shard_state", "resident")
+    cfg.set("tpu/block_events", 4)
+    cfg.set("tpu/quanta_per_step", 1)
+    cfg.set("tpu/miss_chain", 8)
+    cfg.set("tpu/window_cache", "false")
+    cfg.set("dram/queue_model/enabled", "false")
+    return SimParams.from_config(cfg)
+
+
 def _measure(shards: int, tiles: int, quanta: int, warm: int) -> dict:
     """Warm + timed megarun window of the radix shape at one cell."""
     import jax
@@ -77,19 +106,79 @@ def _measure(shards: int, tiles: int, quanta: int, warm: int) -> dict:
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
     q1 = int(jax.device_get(state.ctr_quantum))
+    from graphite_tpu.engine import resident as resident_mod
+    bytes_moved = resident_mod.modeled_step_bytes(params, state)
     return {
         "kind": "completed",
         "mode": f"shard{shards}",
+        "shard_state": "replicated",
         "tile_shards": shards,
         "devices": len(jax.devices()),
         "num_tiles": tiles,
         "timed_quanta": q1 - q0,
         "seconds": round(dt, 3),
         "quanta_per_s": round((q1 - q0) / max(dt, 1e-9), 3),
+        "modeled_step_bytes_moved": bytes_moved["replicated"],
         "total_quanta": q1,
         "cursor_sum": int(jax.device_get(state.cursor.sum())),
         "workload": f"radix{tiles} weak-scaling window, "
                     f"{KEYS_PER_TILE} keys/tile",
+    }
+
+
+def _measure_resident(shards: int, tiles: int, quanta: int,
+                      warm: int) -> dict:
+    """Resident-mode cell: a migratory chain workload (the traffic shape
+    home-routing is about — barrier-free, inside the resident subset)
+    through engine/resident.megarun.  The modeled-bytes column compares
+    the per-step collective payload of both strategies at this cell's
+    geometry: replicated = the 13 window-output all_gathers' full-T
+    leaves; resident = the two fixed-capacity all_to_alls per chain
+    iteration."""
+    import jax
+
+    from graphite_tpu.engine import resident as resident_mod
+    from graphite_tpu.engine.state import TraceArrays, make_state
+    from graphite_tpu.events import synth
+
+    params = _params_resident(tiles, shards)
+    trace = synth.gen_migratory(tiles, lines=min(64, tiles * 2), rounds=2)
+    tarrays = TraceArrays.from_trace(trace)
+    state = make_state(params, has_capi=False)
+    state = resident_mod.megarun(params, state, tarrays, warm)
+    jax.block_until_ready(state)
+    q0 = int(jax.device_get(state.ctr_quantum))
+    t0 = time.perf_counter()
+    state = resident_mod.megarun(params, state, tarrays, quanta)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    q1 = int(jax.device_get(state.ctr_quantum))
+    bytes_moved = resident_mod.modeled_step_bytes(params, state)
+    # Per-device resident HBM of the tile-sharded leaves: O(T/S).
+    import numpy as np
+    sharded_bytes = 0
+    from graphite_tpu.parallel import mesh as meshmod
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        name = meshmod._path_name(path)
+        if meshmod.resident_spec_for(name, leaf, tiles) \
+                != meshmod.P():
+            sharded_bytes += np.asarray(leaf).nbytes
+    return {
+        "kind": "completed",
+        "mode": f"resident_shard{shards}",
+        "shard_state": "resident",
+        "tile_shards": shards,
+        "devices": len(jax.devices()),
+        "num_tiles": tiles,
+        "timed_quanta": q1 - q0,
+        "seconds": round(dt, 3),
+        "quanta_per_s": round((q1 - q0) / max(dt, 1e-9), 3),
+        "modeled_step_bytes_moved": bytes_moved["resident"],
+        "modeled_step_bytes_moved_replicated": bytes_moved["replicated"],
+        "resident_state_bytes_per_device": sharded_bytes // max(shards, 1),
+        "total_quanta": q1,
+        "cursor_sum": int(jax.device_get(state.cursor.sum())),
+        "workload": f"migratory{tiles} resident weak-scaling window",
     }
 
 
@@ -109,15 +198,17 @@ def _leg_env(shards: int):
     return repo, env
 
 
-def run_leg(shards: int, tiles: int, quanta: int, warm: int) -> None:
+def run_leg(shards: int, tiles: int, quanta: int, warm: int,
+            resident: bool = False) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
     jax.config.update("jax_enable_x64", True)
     from graphite_tpu.compile_cache import enable_compile_cache
     enable_compile_cache()
+    fn = _measure_resident if resident else _measure
     print("WEAK_SCALING_ROW "
-          + json.dumps(_measure(shards, tiles, quanta, warm)), flush=True)
+          + json.dumps(fn(shards, tiles, quanta, warm)), flush=True)
 
 
 def run_bench_shard8(tiles: int = 1024, quanta: int = QUANTA,
@@ -216,6 +307,11 @@ def main() -> int:
         i = argv.index("--leg")
         run_leg(int(argv[i + 1]), int(argv[i + 2]), quanta, warm)
         return 0
+    if "--leg-resident" in argv:
+        i = argv.index("--leg-resident")
+        run_leg(int(argv[i + 1]), int(argv[i + 2]), quanta, warm,
+                resident=True)
+        return 0
     if "--bench-shard8" in argv:
         run_bench_shard8(int(_flag(argv, "--tiles", 1024)), quanta, warm)
         return 0
@@ -230,24 +326,28 @@ def main() -> int:
                                     str(DEFAULT_BUDGET_S)))
     t_start = time.monotonic()
     detail = {}
+    modes = [("", ["--leg"])]
+    if "--no-resident" not in argv:
+        modes.append(("resident_", ["--leg-resident"]))
     for t in tiles:
-        for s in shards:
-            label = f"weak_scaling_shard{s}_T{t}"
-            elapsed = time.monotonic() - t_start
-            if elapsed > budget_s:
-                detail[label] = {"kind": "skipped_budget",
-                                 "elapsed_s": round(elapsed, 1),
-                                 "budget_s": budget_s}
-                print(f"{label}: skipped_budget", file=sys.stderr,
-                      flush=True)
-                continue
-            row = _subprocess_cell(
-                ["--leg", str(s), str(t), "--quanta", str(quanta),
-                 "--warm", str(warm)],
-                s, timeout=max(budget_s - elapsed, 60.0))
-            detail[label] = row
-            print(f"{label}: {row.get('quanta_per_s', row['kind'])}",
-                  file=sys.stderr, flush=True)
+        for mode_tag, leg_flag in modes:
+            for s in shards:
+                label = f"weak_scaling_{mode_tag}shard{s}_T{t}"
+                elapsed = time.monotonic() - t_start
+                if elapsed > budget_s:
+                    detail[label] = {"kind": "skipped_budget",
+                                     "elapsed_s": round(elapsed, 1),
+                                     "budget_s": budget_s}
+                    print(f"{label}: skipped_budget", file=sys.stderr,
+                          flush=True)
+                    continue
+                row = _subprocess_cell(
+                    leg_flag + [str(s), str(t), "--quanta", str(quanta),
+                                "--warm", str(warm)],
+                    s, timeout=max(budget_s - elapsed, 60.0))
+                detail[label] = row
+                print(f"{label}: {row.get('quanta_per_s', row['kind'])}",
+                      file=sys.stderr, flush=True)
     print(json.dumps({"metric": "weak_scaling", "detail": detail}))
     return 0
 
