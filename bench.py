@@ -194,6 +194,13 @@ def _run(trace_fn, num_tiles: int, max_steps=None, label=None, **overrides):
             summary.counters["chain_fanout_served"].sum())
         row["chain_fallback"] = int(
             summary.counters["chain_fallback"].sum())
+    if sim.ingest is not None:
+        # Round-16 streaming ingest: flatten the summary's ingest
+        # section (seams, prefetch/rebuild split, stall seconds +
+        # fraction, peak device trace bytes) into the row so
+        # results_db's ingest_stall_fraction / peak_device_trace_bytes
+        # chains see bench rows and RunReports alike.
+        row.update(summary.ingest_section())
     if params.fast_forward > 0:
         # Round-12 adaptive-fidelity attribution: engaged analytic
         # rounds, events priced in closed form, and the headline
@@ -807,6 +814,36 @@ def main(argv=None) -> int:
     # working end to end (results_db keyed on structural + variant
     # signatures + trace hash).
     safe("radix8_service", _service_row)
+
+    def _streamed_row():
+        """Round-16 streaming-ingest row: a radix8 trace with a per-tile
+        event axis ~4x the longest current synthetic (keys_per_tile =
+        8192 vs the radix64 headline's 2048), simulated with only TWO
+        segment-sized trace slices device-resident — the
+        bigger-than-HBM demonstration, with the device trace footprint
+        capped at peak_device_trace_bytes regardless of trace length.
+        Segment sizing forces well past the acceptance floor of 4
+        seams; ingest_stall_fraction is the double-buffering headline
+        (near-zero = prefetch fully hides uploads behind megasteps) and
+        chains in results_db with a >20% growth flag."""
+        KEYS, SEG, T = 8192, 4096, 8
+        trace_fn = lambda _: _synth_cached(
+            "gen_radix", synth.gen_radix, num_tiles=T,
+            keys_per_tile=KEYS, radix=64)
+        row = _run(trace_fn, T, label="radix8_streamed",
+                   **{"trace/segment_events": SEG})
+        n_total = trace_fn(T).ops.shape[1]
+        whole_bytes = T * n_total * (8 + 3 * 4)
+        row["trace_events_per_tile"] = n_total
+        row["whole_trace_bytes"] = whole_bytes
+        if row.get("peak_device_trace_bytes"):
+            row["trace_bytes_vs_whole"] = round(
+                row["peak_device_trace_bytes"] / whole_bytes, 4)
+        row["workload"] = ("radix8 long trace via streaming segmented "
+                           "ingest (two resident segments)")
+        return row
+
+    safe("radix8_streamed", _streamed_row)
 
     # BASELINE config 1 scaling: radix at 256 and 1024 tiles.  Every
     # point COMPLETES (valid MIPS) — the 1024 row runs a narrow block

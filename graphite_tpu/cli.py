@@ -34,6 +34,17 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="enable run telemetry (host span tracing + "
                           "[telemetry] round metrics) and write "
                           "run_report.json + run_trace.json under DIR")
+    run.add_argument("--segment-events", type=int, default=None,
+                     metavar="N",
+                     help="streaming segmented ingest (round 16): keep "
+                          "only two N-event device-resident trace "
+                          "segments (active + prefetch) and stream the "
+                          "host trace through them — traces bigger than "
+                          "HBM run whole, bit-identically. Shorthand "
+                          "for --trace/segment_events=N. Unvalidated "
+                          "combinations (resident shard_state, "
+                          "fast_forward, multi-thread scheduling) are "
+                          "rejected loudly")
 
     sw = sub.add_parser(
         "sweep", help="run V config variants of one trace as a single "
@@ -68,6 +79,14 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="results_db sqlite path: completed tickets are "
                          "stored and identical re-submissions are served "
                          "from cache without simulating")
+    sw.add_argument("--segment-events", type=int, default=None,
+                    metavar="N",
+                    help="key tickets on the N-event streamed content "
+                         "hash (events/segments.py) instead of the "
+                         "whole-trace hash — identical streamed "
+                         "submissions share DONE tickets and cached "
+                         "rows. Shorthand for --trace/segment_events=N "
+                         "(buckets still execute whole-trace)")
     sw.add_argument("--metrics-path", default=None, metavar="PATH",
                     help="(--serve only) enable the obs metrics "
                          "registry and write its Prometheus text "
@@ -107,6 +126,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if telemetry_dir and not any(p == "telemetry/enabled"
                                  for p, _ in overrides):
         cfg.set("telemetry/enabled", "true")
+    if getattr(args, "segment_events", None) is not None:
+        cfg.set("trace/segment_events", int(args.segment_events))
     from graphite_tpu import log as logmod
     logmod.configure(cfg)
 

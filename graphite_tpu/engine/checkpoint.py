@@ -32,7 +32,12 @@ import numpy as np
 from graphite_tpu.engine.state import SimState, make_state
 from graphite_tpu.params import SimParams
 
-_SCHEMA_VERSION = 26  # v26: resident tile-sharded runs (tpu/shard_state)
+_SCHEMA_VERSION = 27  # v27: streaming segmented ingest (round 16) —
+#   streamed runs checkpoint at segment seams and record the ingest
+#   frame (__ingest_base / __ingest_segment_events / __ingest_n_total)
+#   beside the state leaves; state semantics are unchanged, so v26
+#   files (whole-trace) still restore (see _check_schema);
+#   v26: resident tile-sharded runs (tpu/shard_state)
 #   — checkpoints stay whole-array (the flatten seam gathers sharded
 #   leaves via np.asarray, the ONLY full-T materialization point of a
 #   resident run), and restore re-places tile-sharded in
@@ -140,11 +145,17 @@ def _open_checkpoint(path: str):
     return z
 
 
+# v27 added ingest metadata WITHOUT touching state-leaf semantics, so
+# v26 (whole-trace) checkpoints restore unchanged; anything older
+# predates the routed-resolve counter semantics and is rejected.
+_COMPATIBLE_SCHEMAS = (26, 27)
+
+
 def _check_schema(path: str, z) -> None:
-    if int(z["__meta_schema"]) != _SCHEMA_VERSION:
+    if int(z["__meta_schema"]) not in _COMPATIBLE_SCHEMAS:
         raise ValueError(
-            f"checkpoint schema {int(z['__meta_schema'])} != "
-            f"{_SCHEMA_VERSION}")
+            f"checkpoint schema {int(z['__meta_schema'])} not in "
+            f"{_COMPATIBLE_SCHEMAS}")
 
 
 def _load_leaves(path: str, z, template: SimState) -> SimState:
@@ -183,11 +194,39 @@ def _load_leaves(path: str, z, template: SimState) -> SimState:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def save_checkpoint(path: str, state: SimState, steps: int = 0) -> None:
+def save_checkpoint(path: str, state: SimState, steps: int = 0,
+                    ingest: dict = None) -> None:
+    """``ingest`` (streamed runs, engine/ingest.py — saved at segment
+    seams) records the ingest frame beside the state: per-row segment
+    bases, the segment capacity, and the full stream length.  Restore
+    could derive valid bases from cursors alone (base placement never
+    affects values, only which columns are resident), but the exact
+    frame makes a resumed run's swap schedule — and thus its stall
+    profile — match the original."""
     arrays, _ = _flatten_with_paths(state)
     arrays["__meta_steps"] = np.int64(steps)
     arrays["__meta_schema"] = np.int64(_SCHEMA_VERSION)
+    if ingest is not None:
+        arrays["__ingest_base"] = np.asarray(ingest["base"],
+                                             dtype=np.int32)
+        arrays["__ingest_segment_events"] = np.int64(
+            ingest["segment_events"])
+        arrays["__ingest_n_total"] = np.int64(ingest["n_total"])
     _atomic_savez(path, arrays)
+
+
+def load_ingest(path: str) -> dict:
+    """The ingest frame a v27 streamed checkpoint carries, or None for a
+    whole-trace checkpoint (state loading ignores these keys either way
+    — _load_leaves iterates the TEMPLATE's paths)."""
+    with _open_checkpoint(path) as z:
+        if "__ingest_base" not in z.files:
+            return None
+        return {
+            "base": np.asarray(z["__ingest_base"], dtype=np.int32),
+            "segment_events": int(z["__ingest_segment_events"]),
+            "n_total": int(z["__ingest_n_total"]),
+        }
 
 
 def load_checkpoint(path: str, params: SimParams) -> Tuple[SimState, int]:

@@ -101,13 +101,19 @@ def _window_slice_gather(st: SimState, trace: TraceArrays, width: int):
     N = trace.num_events
     pos = st.cursor[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
     idx = jnp.minimum(pos, N - 1)
+    # Streamed segments (engine/ingest.py): indices stay GLOBAL up to
+    # here — the clamp above is against the full stream length — and
+    # rebase into resident columns only at the gather (identity for a
+    # whole-trace TraceArrays).
     if st.sched_enabled:
         srow = st.seat_stream
-        meta = trace.meta[:, srow[:, None], idx]          # [3, T, width]
-        addr = trace.addr[srow[:, None], idx]             # [T, width]
+        cidx = trace.local_cols(idx, rows=srow)
+        meta = trace.meta[:, srow[:, None], cidx]         # [3, T, width]
+        addr = trace.addr[srow[:, None], cidx]            # [T, width]
     else:
-        meta = jnp.take_along_axis(trace.meta, idx[None], axis=2)
-        addr = jnp.take_along_axis(trace.addr, idx, axis=1)
+        cidx = trace.local_cols(idx)
+        meta = jnp.take_along_axis(trace.meta, cidx[None], axis=2)
+        addr = jnp.take_along_axis(trace.addr, cidx, axis=1)
     return meta, addr
 
 
@@ -441,8 +447,10 @@ def _complex_slot(params: SimParams, vp: VariantParams, state: SimState,
         active = active & (st.mq_count == 0)
     cur = jnp.minimum(st.cursor, N - 1)
     srow = st.seat_stream if st.sched_enabled else rows
-    ev = trace.meta[:, srow, cur]          # [3, T] one fused gather
-    addr = trace.addr[srow, cur]
+    ccur = trace.local_cols(cur, rows=srow)   # segment rebase (identity
+    #   for a whole-trace TraceArrays — engine/ingest.py)
+    ev = trace.meta[:, srow, ccur]         # [3, T] one fused gather
+    addr = trace.addr[srow, ccur]
     op = jnp.where(active, ev[0], EventOp.NOP)
     arg = ev[1]
     arg2 = ev[2]
@@ -1014,7 +1022,7 @@ def _complex_slot_guarded(params: SimParams, vp: VariantParams,
         cur = jnp.minimum(state.cursor, N - 1)
         srow = state.seat_stream if state.sched_enabled \
             else jnp.arange(params.num_tiles)
-        op = trace.meta[0, srow, cur]
+        op = trace.meta[0, srow, trace.local_cols(cur, rows=srow)]
         window_class = ((op == EventOp.COMPUTE) | (op == EventOp.BRANCH)
                         | (op == EventOp.MEM_READ)
                         | (op == EventOp.MEM_WRITE)

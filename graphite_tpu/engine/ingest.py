@@ -1,0 +1,349 @@
+"""Streaming segmented trace ingest — double-buffered host→device prefetch.
+
+The reference's Pin frontend is a LIVE event source feeding the timing
+models (pin/instruction_modeling.cc analysis calls); our rebuild loaded
+every trace whole at startup, so trace length was bounded by HBM and
+capture-then-simulate was a two-epoch workflow.  This module converts
+ingest into a pipelined hot path: the device holds exactly TWO
+fixed-capacity trace segments (active + prefetch), the host uploads the
+predicted next window while the current megarun executes (ZSim's
+bound-weave phasing, applied to the event feed), and device trace memory
+is O(segment_events) for any trace length.
+
+Bit-identity contract (the whole design hangs off it):
+
+  * Engine reads stay in GLOBAL event coordinates; the active segment is
+    per-row columns [base[r], base[r]+C) and indices rebase at the gather
+    (TraceArrays.local_cols).  Bases are capped at max(N-C, 0), so the
+    trace-end clamp (min(pos, N-1)) always lands on a REAL resident
+    column — every readable index yields the whole-trace value.
+  * One quantum step reads at most ``params.ingest_lookahead`` (L) events
+    past any cursor (the window cache's refresh gathers its full [T, WC]
+    span; cursors are monotone within a step).  The streamed megarun
+    (``megarun``) runs quantum steps SPECULATIVELY: after each step it
+    evaluates the per-row overrun guard
+
+        (cursor + L > base + C) and (base + C < n_total)
+
+    on the SPECULATIVE state and rolls the whole quantum back when any
+    row fires — by cursor monotonicity the guard fires whenever any
+    intermediate read COULD have left the segment, so committed quanta
+    only ever saw in-segment (= whole-trace) values and the committed
+    state sequence equals the whole-trace sequence bit for bit, every
+    SimState leaf (ctr_quantum and the sample rings revert with the
+    rollback).  The guard must be evaluated on the speculative state:
+    the rolled-back state satisfies the headroom invariant by
+    construction and would never flag (livelock).
+  * A fired guard ends the megarun (the "segment exhausted"
+    generalization of the window cache's refresh guard — swaps happen
+    only at megarun window boundaries) and returns the overrun mask to
+    the host, which swaps: flagged rows whose committed cursor fits the
+    PREFETCHED window ([pbase, pbase+C) with L headroom) adopt it via a
+    device select; the rest take a synchronous host rebuild at their
+    committed cursor (maximum headroom) — counted entirely as ingest
+    stall.  Progress: a swap strictly advances each flagged row's base
+    whenever at least one quantum committed since the last swap, which
+    holds as long as C - L exceeds the largest single-quantum event
+    consumption (a quantum runs MANY window rounds, so this is far
+    beyond the C >= 2L floor __post_init__ enforces — size segments
+    generously; thousands of events, not hundreds).  If a quantum ever
+    consumes more than C - L events from a fresh rebuild, the swap
+    detects zero base progress and raises loudly instead of
+    livelocking.
+
+Validated subset (everything else refuses loudly — params.__post_init__
+for params-only combinations, ``validate_streaming`` for trace-dependent
+ones): shard_state=replicated (tile_shards > 1 included — the guard and
+trace stay replicated, shard-identical), fast_forward=0, one stream per
+tile (the ThreadScheduler's seat indirection would decouple rows from
+cursors).  Resident shard_state composes later (ROADMAP).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from graphite_tpu.config import ConfigError
+from graphite_tpu.engine.quantum import quantum_step
+from graphite_tpu.engine.state import SimState, TraceArrays
+from graphite_tpu.engine.vparams import VariantParams, variant_params
+from graphite_tpu.events.schema import Trace
+from graphite_tpu.events.segments import SegmentPlan
+from graphite_tpu.params import SimParams
+
+__all__ = ["StreamingIngest", "validate_streaming", "megarun"]
+
+def validate_streaming(params: SimParams, num_streams: int) -> None:
+    """Trace-dependent streaming checks (params-only combinations reject
+    in SimParams.__post_init__).  Loud ConfigError, never a quiet
+    fallback to the whole-trace program."""
+    if params.segment_events <= 0:
+        return
+    if num_streams > params.num_tiles:
+        raise ConfigError(
+            f"trace/segment_events: streamed ingest with "
+            f"{num_streams} streams > {params.num_tiles} tiles (the "
+            f"ThreadScheduler) is not validated — seat rotation "
+            f"decouples tile cursors from trace rows; run multi-thread "
+            f"traces whole")
+
+
+# ------------------------------------------------- streamed megarun
+# (the replicated quantum program of engine/quantum.megarun, with the
+# speculative-step/rollback carry and the overrun mask as a second
+# output; engine/quantum.megarun stays byte-identical for whole traces)
+
+def _overrun_guard(params: SimParams, trace: TraceArrays):
+    C = trace.addr.shape[1]
+    L = params.ingest_lookahead
+
+    def guard(st: SimState) -> jnp.ndarray:
+        lim = trace.base + C                       # [T] int32, global
+        # Tail segments (covering column n_total-1) are exempt: the
+        # global clamp keeps every read in-segment there.  No done/park
+        # masking — the window-cache refresh gathers every row, so even
+        # a finished row's read span must stay resident.
+        return (st.cursor + L > lim) & (lim < trace.n_total)
+
+    return guard
+
+
+def _streamed_loop(params: SimParams, vp: VariantParams, state: SimState,
+                   trace: TraceArrays, max_quanta
+                   ) -> Tuple[SimState, jnp.ndarray]:
+    guard = _overrun_guard(params, trace)
+    start = state.ctr_quantum
+    budget = jnp.asarray(max_quanta, jnp.int64)
+
+    def cond(carry):
+        st, done, om = carry
+        return (~done) & (~om.any()) \
+            & ((st.ctr_quantum - start) < budget)
+
+    def body(carry):
+        st, _done, _om = carry
+        new = quantum_step(params, st, trace, vp=vp)
+        nom = guard(new)                 # on the SPECULATIVE state
+        over = nom.any()
+        # Roll the whole quantum back when any row may have read past
+        # its segment — ctr_quantum, counters, and the sample rings all
+        # revert with it, so the committed sequence is exactly the
+        # whole-trace quantum sequence.
+        st = jax.tree_util.tree_map(
+            lambda o, n: jnp.where(over, o, n), st, new)
+        return st, st.all_done(), nom
+
+    om0 = jnp.zeros(state.cursor.shape[0], dtype=bool)
+    state, _, om = jax.lax.while_loop(
+        cond, body, (state, state.all_done(), om0))
+    return state, om
+
+
+def _megarun_impl(params: SimParams, state: SimState, trace: TraceArrays,
+                  max_quanta) -> Tuple[SimState, jnp.ndarray]:
+    from graphite_tpu.parallel.mesh import shard_wrap
+
+    def run(state, trace, vp, mq):
+        return _streamed_loop(params, vp, state, trace, mq)
+
+    return shard_wrap(params.tile_shards, run, 4)(
+        state, trace, variant_params(params), max_quanta)
+
+
+# Never donates: the rollback carry aliases old and new state inside the
+# loop, and streamed runs redispatch against fresh trace buffers anyway
+# (see quantum.state_donation_enabled for the donation hazard history).
+_megarun = partial(jax.jit, static_argnums=0)(_megarun_impl)
+
+
+def megarun(params: SimParams, state: SimState, trace: TraceArrays,
+            max_quanta) -> Tuple[SimState, jnp.ndarray]:
+    """Streamed megarun: quantum steps on device until done, budget
+    exhaustion, or a segment overrun; returns (state, overrun mask).
+    A True row in the mask means the megarun stopped at a segment seam
+    — swap via StreamingIngest.swap and redispatch."""
+    if trace.base is None:
+        raise ValueError("streamed megarun needs a segmented TraceArrays "
+                         "(StreamingIngest.arrays); whole traces run "
+                         "through engine/quantum.megarun")
+    return _megarun(params, state, trace, max_quanta)
+
+
+# --------------------------------------------------- host-side ingest
+
+def _metrics():
+    from graphite_tpu.obs.registry import ingest_metrics
+    return ingest_metrics()
+
+
+class StreamingIngest:
+    """Double-buffered host→device segment feed for one run.
+
+    Owns the host-resident full trace (engine layout), the device-
+    resident active segment (``arrays`` — what the streamed megarun
+    reads), one prefetch buffer, and the swap/stall accounting.  Driver
+    protocol (engine/sim.Simulator.run):
+
+        dispatch megarun          # async
+        ingest.start_prefetch()   # host slice + device_put overlap it
+        ... device_get results ...
+        if om.any(): trace = ingest.swap(om, cursor)   # the seam
+    """
+
+    def __init__(self, params: SimParams, trace: Trace):
+        if params.segment_events <= 0:
+            raise ValueError("StreamingIngest needs trace/segment_events "
+                             "> 0")
+        validate_streaming(params, trace.num_tiles)
+        self.params = params
+        self.plan = SegmentPlan(trace, params.segment_events)
+        self.lookahead = params.ingest_lookahead
+        # Prefetch prediction stride: half a segment keeps the committed
+        # cursor inside BOTH the active and the predicted window around
+        # the expected swap point, so steady-state swaps adopt the
+        # prefetch instead of hard-rebuilding.
+        self.step = max(self.plan.segment_events // 2, 1)
+        self.bases = np.zeros(self.plan.num_rows, dtype=np.int32)
+        addr, meta = self.plan.slice_rows(self.bases)
+        from graphite_tpu.obs import span
+        with span("ingest.upload", events=int(addr.size),
+                  segment_events=self.plan.segment_events):
+            self.arrays = TraceArrays(
+                addr=jax.device_put(jnp.asarray(addr)),
+                meta=jax.device_put(jnp.asarray(meta)),
+                base=jax.device_put(jnp.asarray(self.bases)),
+                n_total=int(self.plan.n_total))
+        self._prefetch: Optional[Tuple[np.ndarray, jnp.ndarray,
+                                       jnp.ndarray]] = None
+        # -- accounting (SimSummary/bench surface these)
+        self.seams = 0                 # swap events (segment seams hit)
+        self.rows_prefetched = 0       # flagged rows served by prefetch
+        self.rows_rebuilt = 0          # flagged rows hard-rebuilt
+        self.stall_seconds = 0.0       # host time the pipeline blocked
+        self.peak_device_trace_bytes = self.plan.segment_bytes() * (
+            2 if self.plan.num_segments > 1 else 1)
+        self.base_sum = 0              # monotone swap-progress witness
+        self._last_swap_prefetched = False
+        _metrics()[2].set(self.peak_device_trace_bytes)
+
+    def start_prefetch(self) -> None:
+        """Build + upload the predicted next per-row window.  Called
+        right after the megarun dispatch: the host slice and the
+        device_put overlap the device compute (that overlap IS the
+        double buffer)."""
+        if self._prefetch is not None or self.plan.num_segments <= 1:
+            return
+        pb = self.plan.cap_bases(self.bases.astype(np.int64) + self.step)
+        if np.array_equal(pb, self.bases):
+            return     # every row already holds its tail segment
+        from graphite_tpu.obs import span
+        addr, meta = self.plan.slice_rows(pb)
+        with span("ingest.prefetch", events=int(addr.size)):
+            self._prefetch = (pb, jax.device_put(jnp.asarray(addr)),
+                              jax.device_put(jnp.asarray(meta)))
+
+    def swap(self, overrun: np.ndarray, cursor: np.ndarray) -> TraceArrays:
+        """Serve one segment seam: advance every flagged row's segment
+        and return the new active TraceArrays.  The whole call is
+        pipeline-blocking, so its wall time is the ingest stall."""
+        t0 = time.perf_counter()
+        flagged = np.asarray(overrun, dtype=bool)
+        cur = np.asarray(cursor, dtype=np.int64)
+        if not flagged.any():
+            return self.arrays
+        from graphite_tpu.obs import span
+        with span("ingest.swap", rows=int(flagged.sum())):
+            self._swap(flagged, cur)
+        dt = time.perf_counter() - t0
+        self.stall_seconds += dt
+        counter, hist, _gauge = _metrics()
+        if self._last_swap_prefetched:
+            counter.inc()
+        hist.observe(dt)
+        return self.arrays
+
+    def _swap(self, flagged: np.ndarray, cur: np.ndarray) -> None:
+        C = self.plan.segment_events
+        L = self.lookahead
+        new_bases = self.bases.astype(np.int64).copy()
+        can = np.zeros(self.plan.num_rows, dtype=bool)
+        if self._prefetch is not None:
+            pb = self._prefetch[0].astype(np.int64)
+            can = flagged & (pb <= cur) & (cur + L <= pb + C)
+            new_bases[can] = pb[can]
+        hard = flagged & ~can
+        new_bases[hard] = np.minimum(cur[hard], self.plan.max_base)
+        new_bases = self.plan.cap_bases(new_bases)
+        if not (new_bases[flagged] > self.bases[flagged]).all():
+            # Unreachable given C >= 2L (params.__post_init__): every
+            # flagged row's committed cursor strictly exceeds its base.
+            raise RuntimeError(
+                "streaming ingest made no progress at a segment seam: "
+                "a single quantum consumed more than segment_events - "
+                "lookahead events, so even a rebuild at the committed "
+                "cursor cannot give the next quantum headroom — raise "
+                "trace/segment_events (size it several times the "
+                "largest single-quantum event consumption)")
+        addr, meta = self.arrays.addr, self.arrays.meta
+        if can.any():
+            # The wait for the in-flight upload is the stall the
+            # prefetch overlap exists to hide (near-zero when it kept
+            # up with the megarun).
+            _, paddr, pmeta = self._prefetch
+            paddr.block_until_ready()
+            pmeta.block_until_ready()
+            cd = jnp.asarray(can)
+            addr = jnp.where(cd[:, None], paddr, addr)
+            meta = jnp.where(cd[None, :, None], pmeta, meta)
+        if hard.any():
+            haddr, hmeta = self.plan.slice_rows(new_bases)
+            hd = jnp.asarray(hard)
+            addr = jnp.where(hd[:, None], jnp.asarray(haddr), addr)
+            meta = jnp.where(hd[None, :, None], jnp.asarray(hmeta), meta)
+        self.bases = new_bases
+        self.arrays = TraceArrays(
+            addr=addr, meta=meta, base=jnp.asarray(new_bases),
+            n_total=self.arrays.n_total)
+        self._prefetch = None          # consumed / stale — rebuilt after
+        #   the next dispatch
+        self.seams += 1
+        self.rows_prefetched += int(can.sum())
+        self.rows_rebuilt += int(hard.sum())
+        self.base_sum = int(new_bases.sum())
+        self._last_swap_prefetched = bool(can.any())
+
+    def rebase(self, bases: np.ndarray) -> None:
+        """Re-slice the active segment at explicit per-row bases
+        (checkpoint restore).  Bases are capped; any base <= the row's
+        cursor resumes bit-identically — placement decides residency,
+        never values."""
+        self.bases = self.plan.cap_bases(bases)
+        addr, meta = self.plan.slice_rows(self.bases)
+        self.arrays = TraceArrays(
+            addr=jax.device_put(jnp.asarray(addr)),
+            meta=jax.device_put(jnp.asarray(meta)),
+            base=jax.device_put(jnp.asarray(self.bases)),
+            n_total=int(self.plan.n_total))
+        self._prefetch = None
+        self.base_sum = int(self.bases.astype(np.int64).sum())
+
+    def stall_fraction(self, host_seconds: float) -> float:
+        return self.stall_seconds / host_seconds if host_seconds > 0 \
+            else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "segment_events": self.plan.segment_events,
+            "num_segments": self.plan.num_segments,
+            "seams": self.seams,
+            "rows_prefetched": self.rows_prefetched,
+            "rows_rebuilt": self.rows_rebuilt,
+            "ingest_stall_seconds": round(self.stall_seconds, 6),
+            "peak_device_trace_bytes": self.peak_device_trace_bytes,
+        }

@@ -33,10 +33,14 @@ class SimSummary:
     """Counter roll-up with sim.out-style rendering."""
 
     def __init__(self, params: SimParams, state: SimState,
-                 host_seconds: float, steps: int):
+                 host_seconds: float, steps: int,
+                 ingest_stats: Optional[Dict] = None):
         self.params = params
         self.host_seconds = host_seconds
         self.steps = steps
+        # Streaming-ingest accounting (engine/ingest.py stats dict);
+        # None for whole-trace runs.
+        self.ingest_stats = ingest_stats
         self.quanta = int(state.ctr_quantum)
         self.clock = np.asarray(state.clock)
         # Per-STREAM done (== per-tile when the scheduler is off).  A
@@ -238,6 +242,22 @@ class SimSummary:
         vm_sec = self.vm_summary()
         if vm_sec is not None:
             out["vm"] = vm_sec
+        ing = self.ingest_section()
+        if ing is not None:
+            out["ingest"] = ing
+        return out
+
+    def ingest_section(self) -> Optional[Dict]:
+        """Streaming-ingest roll-up (None for whole-trace runs): the
+        engine/ingest.py stats plus the stall FRACTION of this run's
+        host wall clock — the bench's keeps-up metric (near zero when
+        the prefetch hid every seam)."""
+        if self.ingest_stats is None:
+            return None
+        out = dict(self.ingest_stats)
+        out["ingest_stall_fraction"] = round(
+            out["ingest_stall_seconds"] / self.host_seconds, 6) \
+            if self.host_seconds > 0 else 0.0
         return out
 
     def vm_summary(self):
@@ -374,8 +394,19 @@ class Simulator:
                 f"at least {params.num_tiles}")
         from graphite_tpu.obs import span
         self.params = params
-        with span("trace.device_upload", events=trace.ops.size):
-            self.trace = TraceArrays.from_trace(trace)
+        # Streaming segmented ingest (round 16, trace/segment_events):
+        # only two fixed-capacity segments are ever device-resident
+        # (active + prefetch) and the host feeds the device across
+        # megarun boundaries — traces larger than HBM simulate whole.
+        # engine/ingest.py documents the bit-identity contract.
+        self.ingest = None
+        if params.segment_events > 0:
+            from graphite_tpu.engine import ingest as ingest_mod
+            self.ingest = ingest_mod.StreamingIngest(params, trace)
+            self.trace = self.ingest.arrays
+        else:
+            with span("trace.device_upload", events=trace.ops.size):
+                self.trace = TraceArrays.from_trace(trace)
         # CAPI channel state is O(T^2); only allocate it when the trace
         # actually messages (scan once, host-side).
         from graphite_tpu.isa import EventOp
@@ -432,17 +463,38 @@ class Simulator:
             # cost is attributable in the exported host track.
             with span("sim.compile+window" if first_dispatch
                       else "sim.window", quanta=window * qps):
-                if self.params.shard_state == "resident":
+                om_any = False
+                if self.ingest is not None:
+                    from graphite_tpu.engine import ingest as ingest_mod
+                    # Dispatch is async; the prefetch's host slice +
+                    # upload below overlaps the device compute — that
+                    # overlap IS the double buffer.
+                    self.state, om = ingest_mod.megarun(
+                        self.params, self.state, self.trace, window * qps)
+                    self.ingest.start_prefetch()
+                    done, cursor_sum, clock_sum, quanta, om_any = \
+                        jax.device_get(
+                            (self.state.all_done(),
+                             self.state.cursor.sum(),
+                             self.state.clock.sum(),
+                             self.state.ctr_quantum, om.any()))
+                elif self.params.shard_state == "resident":
                     from graphite_tpu.engine import resident
                     self.state = resident.megarun(
                         self.params, self.state, self.trace, window * qps)
                 else:
                     self.state = megarun(self.params, self.state,
                                          self.trace, window * qps)
-                done, cursor_sum, clock_sum, quanta = jax.device_get(
-                    (self.state.all_done(), self.state.cursor.sum(),
-                     self.state.clock.sum(), self.state.ctr_quantum))
+                if self.ingest is None:
+                    done, cursor_sum, clock_sum, quanta = jax.device_get(
+                        (self.state.all_done(), self.state.cursor.sum(),
+                         self.state.clock.sum(), self.state.ctr_quantum))
             first_dispatch = False
+            if bool(om_any):
+                # Segment seam: the megarun stopped at a quantum
+                # boundary with some stream needing its next segment.
+                om_np, cur_np = jax.device_get((om, self.state.cursor))
+                self.trace = self.ingest.swap(om_np, cur_np)
             # Megastep-equivalent step count (reporting + max_steps
             # budget), from the quanta the device actually ran.
             self.steps = -(-int(quanta) // qps)
@@ -454,7 +506,13 @@ class Simulator:
                     and time.perf_counter() - t0 >= budget_s:
                 self.preempted = True
                 break
-            progress = (int(cursor_sum), int(clock_sum))
+            # Segment swaps count as progress: a seam megarun may
+            # commit zero quanta (the very first quantum needed data),
+            # which is forward motion as long as bases advanced — the
+            # ingest itself raises on a no-progress swap.
+            base_sum = self.ingest.base_sum if self.ingest is not None \
+                else 0
+            progress = (int(cursor_sum), int(clock_sum), base_sum)
             if progress == last_progress:
                 raise DeadlockError(
                     f"no progress after {self.steps} steps "
@@ -469,7 +527,9 @@ class Simulator:
 
     def summary(self) -> SimSummary:
         return SimSummary(self.params, self.state, self.host_seconds,
-                          self.steps)
+                          self.steps,
+                          ingest_stats=self.ingest.stats()
+                          if self.ingest is not None else None)
 
     # -------------------------------------------------- checkpoint/resume
     # (absent in the reference — SURVEY.md section 5.4; pure-array state
@@ -477,11 +537,38 @@ class Simulator:
 
     def save_checkpoint(self, path: str) -> None:
         from graphite_tpu.engine.checkpoint import save_checkpoint
-        save_checkpoint(path, self.state, self.steps)
+        # Streamed runs checkpoint at segment seams (run() only returns
+        # at megarun boundaries, which every seam is): the ingest frame
+        # rides beside the state so resume re-slices the same segments.
+        ingest = None
+        if self.ingest is not None:
+            ingest = {"base": self.ingest.bases,
+                      "segment_events": self.ingest.plan.segment_events,
+                      "n_total": self.ingest.plan.n_total}
+        save_checkpoint(path, self.state, self.steps, ingest=ingest)
 
     def restore_checkpoint(self, path: str) -> None:
         from graphite_tpu.engine.checkpoint import load_checkpoint
         self.state, self.steps = load_checkpoint(path, self.params)
+        if self.ingest is not None:
+            from graphite_tpu.engine.checkpoint import load_ingest
+            frame = load_ingest(path)
+            if frame is not None:
+                if frame["n_total"] != self.ingest.plan.n_total:
+                    raise ValueError(
+                        f"streamed checkpoint was cut from a "
+                        f"{frame['n_total']}-event trace; this trace "
+                        f"has {self.ingest.plan.n_total}")
+                bases = frame["base"]
+            else:
+                # Whole-trace (v26/v27 non-streamed) checkpoint into a
+                # streamed run: derive bases from the restored cursors —
+                # base placement never affects values, only which
+                # columns are resident, so any base <= cursor (capped)
+                # resumes bit-identically.
+                bases = np.asarray(self.state.cursor)
+            self.ingest.rebase(bases)
+            self.trace = self.ingest.arrays
         if self.params.shard_state == "resident" \
                 and self.params.tile_shards > 1:
             # Checkpoints are whole-array (the save seam gathers); a

@@ -129,10 +129,45 @@ class TraceArrays(NamedTuple):
 
     addr: jnp.ndarray  # [T, N] int64 byte address
     meta: jnp.ndarray  # [3, T, N] int32: (op, arg, arg2)
+    # Streaming segmented ingest (engine/ingest.py): when ``base`` is
+    # set, addr/meta hold only a [*, C]-column RESIDENT SEGMENT of a
+    # longer trace — per-row, columns [base[r], base[r] + C) of the full
+    # [*, n_total] event stream.  ``base`` is the per-row global column
+    # of resident column 0 and ``n_total`` the full trace length; engine
+    # reads stay in GLOBAL event coordinates and rebase through
+    # ``local_cols`` at the gather.  Both stay None for the whole-trace
+    # program (None pytree leaves vanish, so the compiled structure —
+    # and the arithmetic, local_cols being the identity — is bit-for-bit
+    # today's).
+    base: Optional[jnp.ndarray] = None    # [rows] int32 global col of col 0
+    n_total: Optional[int] = None         # full trace event count
 
     @property
-    def num_events(self) -> int:
+    def num_events(self):
+        """Global event count per row — the full stream length when this
+        is a resident segment of a streamed trace."""
+        if self.n_total is not None:
+            return self.n_total
         return self.addr.shape[1]
+
+    def local_cols(self, idx, rows=None):
+        """Rebase GLOBAL event indices into resident-segment columns.
+
+        Identity for a whole-trace ``TraceArrays``.  For a segment,
+        subtracts each row's ``base`` (broadcast across trailing axes of
+        ``idx``) and clips into the resident span — out-of-segment
+        indices read junk columns exactly like the trace-end clamp reads
+        junk events, and the streamed megarun (engine/ingest.py) rolls
+        back any quantum whose speculative cursors could have taken such
+        a read, so committed steps only ever see in-segment values.
+        ``rows`` maps each idx row to its trace row (the seated-stream
+        indirection) before the base lookup."""
+        if self.base is None:
+            return idx
+        b = self.base if rows is None else self.base[rows]
+        while b.ndim < jnp.ndim(idx):
+            b = b[..., None]
+        return jnp.clip(idx - b, 0, self.addr.shape[1] - 1)
 
     @classmethod
     def from_trace(cls, trace: Trace) -> "TraceArrays":

@@ -1,0 +1,135 @@
+"""Segment planning for streaming trace ingest (engine/ingest.py).
+
+The whole-trace program uploads every event column to the device at
+startup, so trace length is bounded by HBM and capture-then-simulate is a
+two-epoch workflow.  Streaming mode chunks the [T, N] event arrays into
+fixed-capacity SEGMENTS of ``segment_events`` columns and keeps exactly
+two resident per run (active + prefetch); this module is the host side of
+that split — per-row segment slicing, base-offset capping, and the
+per-segment content digests the sweep service keys streamed tickets on.
+
+Coordinates: engine reads stay GLOBAL (event index into the full [*, N]
+stream); a resident segment covers per-row columns [base[r], base[r]+C)
+and the rebase happens at the gather (TraceArrays.local_cols).  Bases are
+always capped at ``max(N - C, 0)`` so the trace-end clamp (reads at
+min(pos, N-1)) always lands on a REAL resident column — segment values
+are then bit-identical to whole-trace values at every readable index, by
+construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Tuple
+
+import numpy as np
+
+from graphite_tpu.events.schema import Trace
+
+__all__ = ["SegmentPlan", "plan_seams", "streamed_content_hash",
+           "segment_digests"]
+
+
+def plan_seams(n_total: int, segment_events: int) -> List[Tuple[int, int]]:
+    """Uniform [start, end) segment spans of the full stream — the
+    nominal seam schedule (actual swaps are per-row and cursor-driven;
+    this is the reporting/digest granularity)."""
+    if segment_events <= 0:
+        return [(0, n_total)]
+    out = []
+    s = 0
+    while s < n_total:
+        out.append((s, min(s + segment_events, n_total)))
+        s += segment_events
+    return out or [(0, 0)]
+
+
+def segment_digests(trace: Trace, segment_events: int) -> List[str]:
+    """sha256 per uniform segment (ops/addr/arg/arg2 column spans, values
+    + shapes) — the content-addressed identity of each ingest chunk, so
+    a capture still being annotated can hash segments as they land
+    (events/trace_cache.py's philosophy, per chunk)."""
+    digests = []
+    for s, e in plan_seams(trace.num_events, segment_events):
+        h = hashlib.sha256()
+        for a in (trace.ops, trace.addr, trace.arg, trace.arg2):
+            chunk = np.ascontiguousarray(a[:, s:e])
+            h.update(str(chunk.shape).encode())
+            h.update(chunk.tobytes())
+        digests.append(h.hexdigest())
+    return digests
+
+
+def streamed_content_hash(trace: Trace, segment_events: int) -> str:
+    """Durable identity of a STREAMED submission: the chained hash of its
+    per-segment digests (+ the segmentation itself).  Two submissions
+    with equal streamed hashes simulate bit-identically under equal
+    params — streamed execution is bit-identical to whole-trace (the
+    ingest contract), and equal per-segment digests mean equal content —
+    so this keys the sweep service's serve-from-cache tier for streamed
+    traces the way Trace.content_hash does for whole ones."""
+    h = hashlib.sha256()
+    h.update(f"seg{segment_events}".encode())
+    for d in segment_digests(trace, segment_events):
+        h.update(b"\x00")
+        h.update(d.encode())
+    return h.hexdigest()
+
+
+class SegmentPlan:
+    """Host-side segment slicer over one Trace.
+
+    Holds the full event arrays in engine layout (addr int64 [R, N],
+    meta int32 [3, R, N] — stacked ONCE, the same field-leading layout
+    TraceArrays.from_trace builds) and cuts [R, C] per-row windows at
+    arbitrary base offsets: the active segment at init, hard rebuilds at
+    committed cursors, and predicted prefetch windows.
+    """
+
+    def __init__(self, trace: Trace, segment_events: int):
+        if segment_events <= 0:
+            raise ValueError(
+                f"segment_events must be >= 1 for streaming: "
+                f"{segment_events}")
+        addr = np.asarray(trace.addr, dtype=np.int64)
+        if addr.max(initial=0) >= (1 << 37):
+            raise ValueError(
+                "trace addresses must be < 2^37 (int32 line-id layout)")
+        self.addr = addr
+        self.meta = np.stack([
+            np.asarray(trace.ops, dtype=np.int32),
+            np.asarray(trace.arg, dtype=np.int32),
+            np.asarray(trace.arg2, dtype=np.int32),
+        ], axis=0)
+        self.num_rows = addr.shape[0]
+        self.n_total = addr.shape[1]
+        # Resident capacity never exceeds the stream (a segment larger
+        # than the trace IS the whole trace, one segment, zero seams).
+        self.segment_events = min(segment_events, self.n_total)
+        # Highest legal base: keeps column N-1 resident in every tail
+        # segment, so the trace-end clamp reads real data (bit-identity
+        # with the whole-trace clamp junk).
+        self.max_base = max(self.n_total - self.segment_events, 0)
+        self.num_segments = len(plan_seams(self.n_total,
+                                           self.segment_events))
+
+    def cap_bases(self, bases: np.ndarray) -> np.ndarray:
+        return np.clip(np.asarray(bases, dtype=np.int64),
+                       0, self.max_base).astype(np.int32)
+
+    def slice_rows(self, bases: np.ndarray):
+        """(addr [R, C] int64, meta [3, R, C] int32) holding each row's
+        columns [bases[r], bases[r] + C).  Bases must be pre-capped, so
+        every column is real data (no padding is ever readable)."""
+        C = self.segment_events
+        b = np.asarray(bases, dtype=np.int64)
+        cols = b[:, None] + np.arange(C, dtype=np.int64)[None, :]
+        rows = np.arange(self.num_rows)[:, None]
+        addr = self.addr[rows, cols]
+        meta = self.meta[:, rows, cols]
+        return addr, np.ascontiguousarray(meta)
+
+    def segment_bytes(self) -> int:
+        """Device bytes of ONE resident segment (int64 addr + 3x int32
+        meta per event per row)."""
+        return self.num_rows * self.segment_events * (8 + 3 * 4)

@@ -88,6 +88,14 @@ def build_run_report(summary, tracer=None, workload: Optional[str] = None,
             else:
                 telemetry["per_tile_omitted"] = True
         report["telemetry"] = telemetry
+    # Streaming-ingest roll-up (round 16): seams, stall seconds/fraction,
+    # prefetch hit counts, peak device trace bytes.  Whole-trace runs
+    # (and summary shapes without the accessor) omit the section.
+    ing = getattr(summary, "ingest_section", None)
+    if ing is not None:
+        ing = ing()
+        if ing is not None:
+            report["ingest"] = ing
     if extra:
         report.update(extra)
     return report

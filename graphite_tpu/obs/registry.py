@@ -31,13 +31,21 @@ from typing import Dict, Iterable, List, Optional, Tuple
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "get_registry", "enable_metrics", "metrics_enabled",
            "render_exposition", "parse_exposition", "write_exposition",
-           "DEFAULT_LATENCY_BUCKETS"]
+           "DEFAULT_LATENCY_BUCKETS", "INGEST_STALL_BUCKETS",
+           "ingest_metrics"]
 
 # Ticket/first-result latency bucket bounds (seconds).  Serving latencies
 # straddle "cache hit" (sub-ms) to "compile + long bucket" (minutes).
 DEFAULT_LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+# Streaming-ingest seam stalls (seconds): a swap served from a completed
+# prefetch is sub-ms (one device select); a synchronous hard rebuild of a
+# large segment can take whole seconds.
+INGEST_STALL_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
 
 def _label_key(labelnames: Tuple[str, ...],
@@ -295,6 +303,29 @@ def enable_metrics(enabled: bool = True,
         _REGISTRY.reset()
     _REGISTRY.enabled = enabled
     return _REGISTRY
+
+
+def ingest_metrics() -> Tuple[Counter, Histogram, Gauge]:
+    """The streaming-ingest family (engine/ingest.py), get-or-create on
+    the global registry:
+
+      * ``segments_prefetched_total`` — seams served from the completed
+        prefetch buffer (the overlap worked);
+      * ``ingest_stall_seconds`` — per-seam pipeline-blocking wall time
+        (prefetch wait + any synchronous hard rebuild);
+      * ``peak_device_trace_bytes`` — resident device trace footprint of
+        the current run (2x segment bytes when double-buffered).
+    """
+    r = _REGISTRY
+    return (
+        r.counter("segments_prefetched_total",
+                  "Segment seams served from the prefetch buffer"),
+        r.histogram("ingest_stall_seconds",
+                    "Pipeline-blocking seconds per segment seam",
+                    bounds=INGEST_STALL_BUCKETS),
+        r.gauge("peak_device_trace_bytes",
+                "Device-resident trace bytes for the current run"),
+    )
 
 
 # ------------------------------------------------------------ exposition

@@ -875,6 +875,32 @@ class SimParams:
     # (vparams.py), so sweeps get a cost/accuracy axis without
     # recompiling.
     fast_forward_span_ps: int
+    # Round-16 streaming segmented ingest (engine/ingest.py, config key
+    # [trace] segment_events): 0 uploads the whole trace at startup —
+    # today's program, bit for bit.  > 0 keeps only a [T, segment_events]
+    # RESIDENT SEGMENT of the event stream on device (plus one prefetch
+    # buffer uploading the predicted next window while the megarun
+    # runs), bounding device trace memory at O(segment) for any trace
+    # length; the streamed walk is bit-identical to the whole-trace
+    # program (speculative quantum + rollback at segment overruns —
+    # ingest.py's contract).  Must be >= 2x the engine's read lookahead
+    # (``ingest_lookahead``); the not-yet-validated combinations
+    # (resident shard_state, fast_forward, multi-thread scheduling)
+    # reject loudly in __post_init__ / engine/ingest.validate_streaming.
+    segment_events: int
+
+    @property
+    def ingest_lookahead(self) -> int:
+        """Max events past the cursor one engine round may READ (the
+        window-cache refresh gathers the full [T, WC] resident span):
+        the streaming overrun guard's per-row lookahead.  Whole-trace
+        runs never use it."""
+        K = self.block_events
+        if K <= 0:
+            return 1
+        if self.window_cache:
+            return 4 * K     # state._win_cache_width's geometry
+        return K
 
     @property
     def line_size(self) -> int:
@@ -956,6 +982,30 @@ class SimParams:
                 f"{self.stack_base:#x}-{end_stack:#x} must sit between "
                 f"the data segment ({START_DATA:#x}) and the dynamic "
                 f"segment ({START_DYNAMIC:#x})")
+        # Streaming segmented ingest composes only with the validated
+        # subset; every other combination refuses up front (the round-15
+        # resident rule: a config that would quietly run a DIFFERENT
+        # program is worse than one that refuses).
+        if self.segment_events > 0:
+            if self.shard_state != "replicated":
+                raise ConfigError(
+                    "trace/segment_events (streaming ingest) requires "
+                    "tpu/shard_state=replicated — the resident tile-"
+                    "sharded program does not compose with segment "
+                    "swaps yet (tile_shards > 1 replicated is fine)")
+            if self.fast_forward > 0:
+                raise ConfigError(
+                    "trace/segment_events with tpu/fast_forward > 0 is "
+                    "not validated: analytic spans widen the trace "
+                    "lookahead past the segment overrun guard — run "
+                    "streamed traces with fast_forward=0")
+            L = self.ingest_lookahead
+            if self.segment_events < 2 * L:
+                raise ConfigError(
+                    f"trace/segment_events={self.segment_events} must "
+                    f"be >= 2x the engine read lookahead ({L} events — "
+                    f"the window cache's resident span); smaller "
+                    f"segments cannot guarantee swap progress")
 
     def module_freq_ghz(self, module: DVFSModule) -> float:
         """Initial frequency of a module from its DVFS domain."""
@@ -1153,4 +1203,7 @@ class SimParams:
             fast_forward_span_ps=int(ns_to_ps(_nonneg(
                 cfg.get_int("tpu/fast_forward_span", 0),
                 "tpu/fast_forward_span"))),
+            segment_events=_nonneg(
+                cfg.get_int("trace/segment_events", 0),
+                "trace/segment_events"),
         )

@@ -283,10 +283,24 @@ class SweepService:
         from graphite_tpu.log import get_logger
         self._lg = get_logger("service")
         self.trace = trace
-        self.trace_hash = trace.content_hash()
+        cfg = cfg if cfg is not None else load_config()
+        # Streamed submissions (trace/segment_events > 0, round 16) key
+        # on the CHAINED per-segment digest (events/segments.py): a
+        # capture can be hashed segment-by-segment as it lands, and two
+        # submissions with equal streamed hashes simulate bit-identically
+        # under equal params (streamed execution == whole-trace is the
+        # ingest contract) — so DONE tickets and results_db rows are
+        # shared across identical streamed submissions.  Buckets still
+        # EXECUTE whole-trace (the sweep engine vmaps one resident
+        # trace); the hash is the ticket identity, not the run mode.
+        seg = cfg.get_int("trace/segment_events", 0)
+        if seg > 0:
+            from graphite_tpu.events.segments import streamed_content_hash
+            self.trace_hash = streamed_content_hash(trace, seg)
+        else:
+            self.trace_hash = trace.content_hash()
         self.journal_dir = os.path.abspath(journal_dir)
         os.makedirs(self.journal_dir, exist_ok=True)
-        cfg = cfg if cfg is not None else load_config()
         meta_path = os.path.join(self.journal_dir, "meta.json")
         if os.path.exists(meta_path):
             with open(meta_path) as f:

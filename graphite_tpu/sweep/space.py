@@ -107,6 +107,9 @@ STRUCTURAL_LEAVES = frozenset({
     "shard_state",                # replicated vs resident program family
     "route_capacity",             # sizes the resident routing buffers
     "fast_forward",               # compiles the analytic leg in or out
+    "segment_events",             # streaming ingest capacity — sizes the
+    #   resident segment arrays (a SHAPE), so it can never ride a
+    #   vmapped variant axis
 } | {f"{c}.{f}" for c in ("l1i", "l1d", "l2") for f in _CACHE_STRUCT}
   | {f"{n}.atac.{f}" for n in ("net_user", "net_memory")
      for f in _ATAC_STRUCT})

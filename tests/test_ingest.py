@@ -1,0 +1,244 @@
+"""Streaming segmented trace ingest (round 16, engine/ingest.py +
+events/segments.py).
+
+The contract under test: with ``trace/segment_events = N`` only two
+[T, N] trace slices are ever device-resident (active + prefetch), and
+the committed walk is BIT-IDENTICAL to the whole-trace program on every
+SimState leaf — quanta that would read past the resident segment roll
+back whole and replay after the seam swap, so committed quanta are
+exactly the whole-trace quanta.  Seams are pipeline events, not
+simulation events: they may land while tiles hold parked in-flight
+misses, banked chain elements, and live carried windows, and none of it
+may perturb a single counter.
+
+Sizing lore for these shapes (empirical, CPU container): one quantum
+can consume ~100 events per tile (local_advance runs many window rounds
+per quantum — consumption is NOT bounded by block_events), so segments
+need comfortably more headroom than ``segment_events - lookahead``;
+undersized segments fail LOUDLY (RuntimeError) rather than mispricing.
+"""
+
+import numpy as np
+import pytest
+
+from graphite_tpu.config import ConfigError, load_config
+from graphite_tpu.engine import ingest
+from graphite_tpu.engine.sim import Simulator
+from graphite_tpu.events import synth
+from graphite_tpu.events.segments import streamed_content_hash
+from graphite_tpu.params import SimParams
+
+pytestmark = pytest.mark.quick
+
+
+def _params(num_tiles=8, **overrides):
+    cfg = load_config()
+    cfg.set("general/total_cores", num_tiles)
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return SimParams.from_config(cfg)
+
+
+def _named_leaves(state):
+    """(field-qualified name, array) pairs for every SimState leaf —
+    nested pytree fields (caches, counters) are enumerated per leaf so
+    an assertion names exactly what diverged."""
+    import jax
+    out = []
+    for f in type(state)._fields:
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(
+                getattr(state, f))):
+            out.append((f"{f}[{i}]", np.asarray(jax.device_get(leaf))))
+    return out
+
+
+def _assert_states_identical(whole_state, streamed_state):
+    a, b = _named_leaves(whole_state), _named_leaves(streamed_state)
+    assert len(a) == len(b)
+    for (name, x), (_, y) in zip(a, b):
+        assert np.array_equal(x, y), \
+            f"SimState leaf {name} diverged under streaming"
+
+
+# ------------------------------------------------ seam bit-identity
+
+def test_streamed_bit_identical_across_seams():
+    """ACCEPTANCE: a streamed run crossing >= 4 segment seams equals
+    the whole-trace program on EVERY SimState leaf, with every seam
+    served from the prefetch buffer (zero hard rebuilds — the
+    double-buffer kept ahead of the walk)."""
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=96, radix=16,
+                            seed=7)
+    whole = Simulator(_params(), trace)
+    s_whole = whole.run()
+    assert s_whole.done.all()
+
+    streamed = Simulator(_params(**{"trace/segment_events": 256}), trace)
+    s_str = streamed.run()
+    assert s_str.done.all()
+    assert streamed.ingest is not None
+    assert streamed.ingest.seams >= 4
+    assert streamed.ingest.rows_prefetched > 0
+    assert streamed.ingest.rows_rebuilt == 0
+
+    _assert_states_identical(whole.state, streamed.state)
+
+    # The summary's ingest section carries the footprint contract:
+    # exactly two [T, C] segments resident, regardless of trace length.
+    ing = s_str.ingest_section()
+    R, C = trace.ops.shape[0], 256
+    assert ing["peak_device_trace_bytes"] == R * C * (8 + 3 * 4) * 2
+    assert ing["ingest_stall_fraction"] >= 0.0
+    assert ing["seams"] == streamed.ingest.seams
+
+
+@pytest.mark.slow
+def test_streamed_seam_mid_miss_chain_identical():
+    """A seam landing while tiles hold PARKED IN-FLIGHT MISSES (and
+    live carried windows) under the chain replay still commits
+    bit-identically: the overrun rollback discards the speculative
+    quantum whole, so banked chains / pending requests at the seam are
+    exactly the whole-trace program's.  The write-back fft trace is the
+    shape where a seam demonstrably lands mid-miss (asserted, so the
+    test can't silently stop biting)."""
+    import jax
+
+    trace = synth.gen_fft(num_tiles=8, points_per_tile=64,
+                          writeback=True)
+    whole = Simulator(_params(**{"tpu/miss_chain": 12}), trace)
+    s_whole = whole.run()
+    assert s_whole.done.all()
+
+    ps = _params(**{"tpu/miss_chain": 12, "trace/segment_events": 256})
+    sim = Simulator(ps, trace)
+    st, ing = sim.state, sim.ingest
+    pend_at_seam = []
+    while True:
+        st, om = ingest.megarun(ps, st, ing.arrays, 64)
+        ing.start_prefetch()
+        om_np = np.asarray(jax.device_get(om))
+        if om_np.any():
+            pend_at_seam.append(int(
+                (np.asarray(jax.device_get(st.pend_kind)) != 0).sum()))
+            ing.swap(om_np, np.asarray(jax.device_get(st.cursor)))
+            continue
+        if bool(np.asarray(jax.device_get(st.all_done()))):
+            break
+
+    assert ing.seams >= 4
+    assert max(pend_at_seam) > 0, \
+        "no seam landed mid-miss — the shape lost its bite"
+    _assert_states_identical(whole.state, st)
+
+
+# ------------------------------------------- checkpoint at a seam
+
+def test_streamed_checkpoint_resume_at_seam(tmp_path):
+    """Checkpoint a streamed run AFTER a segment seam (per-row bases in
+    the __ingest_* frame), restore into a fresh streamed Simulator, and
+    finish: every SimState leaf equals the whole-trace run's."""
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=96, radix=16,
+                            seed=7)
+    whole = Simulator(_params(), trace)
+    whole.run()
+
+    ps = _params(**{"trace/segment_events": 256})
+    half = Simulator(ps, trace)
+    while half.ingest.seams == 0:
+        s = half.run(max_steps=half.steps + 1)
+        assert not s.done.all(), "completed before the first seam"
+    ck = str(tmp_path / "seam.npz")
+    half.save_checkpoint(ck)
+
+    resumed = Simulator(ps, trace)
+    resumed.restore_checkpoint(ck)
+    assert resumed.steps == half.steps
+    assert np.array_equal(resumed.ingest.bases, half.ingest.bases)
+    s_res = resumed.run()
+    assert s_res.done.all()
+    _assert_states_identical(whole.state, resumed.state)
+
+
+@pytest.mark.slow
+def test_whole_trace_checkpoint_restores_into_streamed_run(tmp_path):
+    """Old-program checkpoints (no __ingest_* frame) restore into a
+    streamed Simulator: bases derive from the committed cursors (base
+    placement decides residency, never values), and the run finishes
+    whole-trace-identical."""
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=96, radix=16,
+                            seed=7)
+    whole = Simulator(_params(), trace)
+    s_whole = whole.run()
+    assert s_whole.done.all()
+
+    half = Simulator(_params(), trace)
+    half.run(max_steps=2)
+    ck = str(tmp_path / "whole.npz")
+    half.save_checkpoint(ck)
+
+    resumed = Simulator(_params(**{"trace/segment_events": 256}), trace)
+    resumed.restore_checkpoint(ck)
+    s_res = resumed.run()
+    assert s_res.done.all()
+    _assert_states_identical(whole.state, resumed.state)
+
+
+# --------------------------------------- loud-refusal contracts
+
+def test_streamed_config_rejections():
+    """Unvalidated combinations refuse at params construction, not at
+    runtime: resident shard placement, fast-forward, and segments too
+    small for the engine's read lookahead."""
+    trace_cfgs = (
+        {"tpu/shard_state": "resident", "tpu/tile_shards": "8",
+         "tpu/miss_chain": 8, "tpu/window_cache": "false",
+         "general/total_cores": 16},
+        {"tpu/fast_forward": 8},
+        {"trace/segment_events": 100},   # < 2x lookahead (128)
+    )
+    for extra in trace_cfgs:
+        cfg = load_config()
+        cfg.set("general/total_cores", 8)
+        cfg.set("trace/segment_events", 256)
+        for k, v in extra.items():
+            cfg.set(k, v)
+        with pytest.raises(ConfigError):
+            SimParams.from_config(cfg)
+
+
+def test_undersized_segment_raises_runtime_error():
+    """A segment that passes the static floor (>= 2x lookahead) but is
+    smaller than one quantum's actual event consumption cannot make
+    progress at the seam — the engine raises the loud sizing
+    RuntimeError instead of mispricing or spinning."""
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=48, radix=16,
+                            seed=7)
+    sim = Simulator(_params(**{"trace/segment_events": 128}), trace)
+    with pytest.raises(RuntimeError, match="segment_events"):
+        sim.run()
+
+
+def test_streams_over_tiles_rejected_when_streaming():
+    """The ThreadScheduler's multi-stream seating is outside the
+    validated streamed subset: more app streams than tiles refuses."""
+    trace = synth.gen_radix(num_tiles=8, keys_per_tile=48, radix=16,
+                            seed=7)
+    params = _params(num_tiles=4, **{"trace/segment_events": 256,
+                                     "general/max_threads_per_core": 2})
+    with pytest.raises(ConfigError):
+        Simulator(params, trace)
+
+
+# ------------------------------------------------- content hashes
+
+def test_streamed_content_hash_properties():
+    """The streamed hash is segment-digest-chained: stable across
+    calls, different from the whole-trace hash, different across
+    segment sizes, and sensitive to trace content."""
+    t1 = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=1)
+    t2 = synth.gen_radix(num_tiles=4, keys_per_tile=16, radix=8, seed=2)
+    h = streamed_content_hash(t1, 256)
+    assert h == streamed_content_hash(t1, 256)
+    assert h != t1.content_hash()
+    assert h != streamed_content_hash(t1, 128)
+    assert h != streamed_content_hash(t2, 256)

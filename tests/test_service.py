@@ -528,3 +528,55 @@ def test_metrics_disabled_service_still_reports_latency(trace, tmp_path):
     assert lat["cache_hit_ratio"] is None    # no db -> no lookups
     # The disabled registry recorded nothing.
     assert get_registry().histogram("ticket_latency_s").count() == 0
+
+
+# --------------------------------- round 16: streamed-trace ticket keying
+
+def test_streamed_hash_keys_tickets_and_serves_cache(trace, tmp_path):
+    """ACCEPTANCE (round 16): with ``trace/segment_events`` set, ticket
+    identity keys on the CHAINED PER-SEGMENT digests of the streamed
+    trace — identical streamed submissions against the same results_db
+    re-serve from cache with zero buckets run, while the streamed key
+    space stays disjoint from the whole-trace key space (same trace,
+    different segmentation = different tickets)."""
+    from graphite_tpu.events.segments import streamed_content_hash
+
+    cfg = _cfg(**{"trace/segment_events": 256})
+    db = str(tmp_path / "results.db")
+    points = [{"dram/latency": v} for v in ("80", "120")]
+
+    svc = _mk(trace, tmp_path / "j1", cfg, db_path=db)
+    assert svc.trace_hash == streamed_content_hash(trace, 256)
+    assert svc.trace_hash != trace.content_hash()
+    t1 = [svc.submit(p) for p in points]
+    r1 = svc.serve()
+    assert all(r1[t].status == DONE for t in t1)
+    assert svc.stats["buckets_run"] == 1
+
+    # Identical streamed re-submission: every ticket from cache.
+    svc2 = _mk(trace, tmp_path / "j2", cfg, db_path=db)
+    t2 = [svc2.submit(p) for p in points]
+    r2 = svc2.serve()
+    assert svc2.stats["buckets_run"] == 0
+    assert svc2.stats["cache_hits"] == len(points)
+    for a, b in zip(t1, t2):
+        assert r2[b].from_cache
+        assert r2[b].summary == r1[a].summary
+
+    # The WHOLE-TRACE submission of the same design points misses the
+    # streamed cache entries (different trace key) and simulates.
+    svc3 = _mk(trace, tmp_path / "j3", _cfg(), db_path=db)
+    assert svc3.trace_hash == trace.content_hash()
+    t3 = [svc3.submit(p) for p in points]
+    r3 = svc3.serve()
+    assert svc3.stats["cache_hits"] == 0
+    assert svc3.stats["buckets_run"] == 1
+    # Buckets execute the whole-trace program either way (streamed ==
+    # whole-trace bit-identity makes the cached summaries sound), so
+    # the SIMULATED numbers agree even though the tickets never shared
+    # a key (host_seconds/mips are wall clock — excluded).
+    for a, b in zip(t1, t3):
+        assert r3[b].summary["clock_ps"] == r1[a].summary["clock_ps"]
+        assert r3[b].summary["completion_time_ns"] == \
+            r1[a].summary["completion_time_ns"]
+        assert r3[b].summary["aggregate"] == r1[a].summary["aggregate"]

@@ -34,8 +34,14 @@ fragmenting out of its single custom-call is a 1 -> N event, invisible
 to every throughput metric on CPU.  Service rows chain two more:
 ``cache_hit_ratio`` (higher is better, drop flags) and
 ``p99_first_result_s`` (serving-latency tail: LOWER is better, a >20%
-GROWTH flags).  Each metric chains to the most recent prior row that
-HAS it, so probe/skipped rows can't mask a later regression.
+GROWTH flags).  Streamed-ingest rows (round 16) chain two more:
+``ingest_stall_fraction`` (pipeline-blocking seam-swap time over host
+time: LOWER is better, a >20% GROWTH flags — prefetch stopped hiding
+uploads) and ``peak_device_trace_bytes`` (the resident segment-pair
+footprint: structural, ANY growth flags); both read bench-row top
+level or a RunReport's nested ``ingest`` section.  Each metric chains
+to the most recent prior row that HAS it, so probe/skipped rows can't
+mask a later regression.
 
 Sweep rows ingest like bench rows: a ``graphite-tpu sweep -o`` output
 or a bench ``radix8_sweep8`` detail row carries ``variants`` +
@@ -205,6 +211,45 @@ def p99_first_result_s(row: dict):
     return v if v > 0 else None
 
 
+def _ingest_field(row: dict, key: str):
+    """Streamed-ingest metric lookup: bench ``*_streamed`` rows carry
+    the fields at top level, RunReports nest them under ``ingest``."""
+    v = row.get(key)
+    if v is None and isinstance(row.get("ingest"), dict):
+        v = row["ingest"].get(key)
+    return v
+
+
+def ingest_stall_fraction(row: dict):
+    """Streaming-ingest health (round 16): pipeline-blocking seam-swap
+    seconds over host seconds.  LOWER is better — near-zero means the
+    double-buffered prefetch kept ahead of the walk; a >threshold
+    GROWTH flags (prefetch stopped hiding uploads behind device
+    compute).  0.0 is a legitimate best-case value and still chains
+    (unlike the throughput metrics, absence — not zero — is the
+    no-data signal).  None for whole-trace rows."""
+    v = _ingest_field(row, "ingest_stall_fraction")
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v >= 0 else None
+
+
+def peak_device_trace_bytes(row: dict):
+    """Device-resident trace footprint of a streamed row: bytes for the
+    resident segment pair (the tentpole's memory ceiling).  Chained as
+    a structural lower-is-better count — ANY increase at a fixed
+    workload means the footprint contract regressed toward whole-trace
+    residency.  None for whole-trace rows."""
+    v = _ingest_field(row, "peak_device_trace_bytes")
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
 def cache_hit_ratio(row: dict):
     """Cache effectiveness of a sweep-service row: hits over lookups,
     in (0, 1].  Chains like a throughput metric — a >threshold drop
@@ -262,6 +307,11 @@ COUNT_METRICS = (
      _count_metric("lowered_step_all_gathers_resident")),
     ("lowered_step_all_to_alls_resident",
      _count_metric("lowered_step_all_to_alls_resident")),
+    # Round 16: device-resident trace footprint of a streamed row.  The
+    # tentpole's whole point is the O(2 * segment) ceiling; at a fixed
+    # workload the byte count is deterministic, so ANY growth means the
+    # streaming contract regressed toward whole-trace residency.
+    ("peak_device_trace_bytes", peak_device_trace_bytes),
 )
 
 
@@ -308,10 +358,18 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
                 f"REGRESSION {workload}: {new:.1f} {name} vs prior "
                 f"{old:.1f} (-{drop:.0f}% > {threshold_pct:.0f}% "
                 f"threshold)")
-    # ISSUE 17 serving-latency tail: LOWER is better, so the flag fires
-    # on GROWTH beyond the threshold (mirror image of the throughput
-    # chains — same most-recent-prior-row-that-has-it chaining).
-    for name, fn in (("p99-first-result-s", p99_first_result_s),):
+    # ISSUE 17 serving-latency tail / round-16 ingest-stall fraction:
+    # LOWER is better, so the flag fires on GROWTH beyond the threshold
+    # (mirror image of the throughput chains — same
+    # most-recent-prior-row-that-has-it chaining).  A zero prior chains
+    # too (the streamed best case): stall APPEARING where prefetch used
+    # to fully hide uploads flags once it clears the threshold as an
+    # absolute fraction of host time.
+    for name, fn, why in (
+            ("p99-first-result-s", p99_first_result_s,
+             "serving latency grew"),
+            ("ingest-stall-fraction", ingest_stall_fraction,
+             "prefetch stopped hiding segment uploads")):
         new = fn(row)
         if new is None:
             continue
@@ -322,14 +380,20 @@ def check_regression(db: sqlite3.Connection, workload: str, row: dict,
             old = fn(json.loads(raw))
             if old is not None:
                 break
-        if old is None or old <= 0:
+        if old is None or old < 0:
+            continue
+        if old == 0:
+            if new * 100.0 > threshold_pct:
+                warnings.append(
+                    f"REGRESSION {workload}: {new:.3f} {name} vs prior "
+                    f"0 ({why})")
             continue
         rise = (new - old) / old * 100.0
         if rise > threshold_pct:
             warnings.append(
                 f"REGRESSION {workload}: {new:.3f} {name} vs prior "
                 f"{old:.3f} (+{rise:.0f}% > {threshold_pct:.0f}% "
-                f"threshold; serving latency grew)")
+                f"threshold; {why})")
     # Structural counts: lower is better, exact — ANY increase over the
     # most recent prior row carrying the metric flags (the window phase
     # fragmenting out of its one custom-call is a 1 -> N event, not a

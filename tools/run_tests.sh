@@ -707,6 +707,95 @@ if [ $obs_rc -ne 0 ]; then
     fail=1
 fi
 
+# Streamed-ingest smoke gate (ISSUE 20 CI satellite): the SAME trace
+# through the CLI twice — whole-trace vs --segment-events — in two
+# subprocesses.  The streamed report must cross >= 4 segment seams with
+# the footprint capped at two segments, agree with the whole-trace run
+# on every aggregate counter and the completion time (the full
+# every-SimState-leaf identity gate lives in tests/test_ingest.py),
+# and export ingest.* spans beside the host spans in the Chrome trace.
+# Then the results_db stall-fraction regression flag must fire on a
+# doctored grown value (and the peak-bytes structural flag on growth).
+ingest_out=$(timeout 1800 python - <<'PYEOF' 2>&1
+import json, os, shutil, subprocess, sys, tempfile
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from graphite_tpu.events import synth
+
+tmp = tempfile.mkdtemp()
+trace_path = os.path.join(tmp, "long.npz")
+synth.gen_radix(2, keys_per_tile=160, radix=16, seed=3).save(trace_path)
+
+BASE = [sys.executable, "-c",
+        "from graphite_tpu.cli import main; raise SystemExit(main())",
+        "--general/total_cores=2"]
+
+def run_cli(tag, extra):
+    d = os.path.join(tmp, tag)
+    os.makedirs(d, exist_ok=True)
+    r = subprocess.run(
+        BASE + ["run", "--trace", trace_path, "--telemetry-dir", d,
+                "-o", os.path.join(d, "sim.out")] + extra,
+        capture_output=True, text=True, timeout=900, cwd=os.getcwd())
+    assert r.returncode == 0, (tag, r.returncode, r.stderr[-2000:])
+    report = json.load(open(os.path.join(d, "run_report.json")))
+    chrome = json.load(open(os.path.join(d, "run_trace.json")))
+    return report, chrome
+
+whole, _ = run_cli("whole", [])
+streamed, chrome = run_cli("seg", ["--segment-events", "256"])
+
+ing = streamed.get("ingest")
+assert ing, "streamed report carries no ingest section"
+assert ing["seams"] >= 4, ing
+assert ing["num_segments"] >= 3, ing
+assert ing["peak_device_trace_bytes"] == 2 * 2 * 256 * (8 + 3 * 4), ing
+assert ing["ingest_stall_fraction"] >= 0.0
+assert "ingest" not in whole, "whole-trace report grew an ingest section"
+
+# Whole-trace agreement on the simulated numbers (counter aggregates +
+# completion time) — the smoke tier of the bit-identity contract.
+assert streamed["completion_time_ps"] == whole["completion_time_ps"], \
+    (streamed["completion_time_ps"], whole["completion_time_ps"])
+assert streamed["counters"] == whole["counters"]
+assert streamed["quanta"] == whole["quanta"]
+
+# Ingest spans render beside the host spans in the Chrome export.
+names = {e.get("name", "") for e in chrome["traceEvents"]
+         if e.get("ph") == "X" and e.get("pid") == 1}
+assert any(n.startswith("ingest.") for n in names), sorted(names)
+
+# results_db: the stall-fraction chain flags a >20% GROWTH, and the
+# peak-bytes structural chain flags ANY growth.
+sys.path.insert(0, os.path.join(os.getcwd(), "tools"))
+import results_db
+rdb = results_db.open_db(os.path.join(tmp, "reg.db"))
+base_row = {"ingest_stall_fraction": max(
+                ing["ingest_stall_fraction"], 0.004),
+            "peak_device_trace_bytes": ing["peak_device_trace_bytes"],
+            "host_seconds": streamed["host_seconds"]}
+assert results_db.check_regression(rdb, "streamed", base_row) is None
+results_db.add_run(rdb, "streamed", base_row)
+grown = dict(base_row)
+grown["ingest_stall_fraction"] = base_row["ingest_stall_fraction"] * 2
+warn = results_db.check_regression(rdb, "streamed", grown)
+assert warn and "ingest-stall-fraction" in warn, warn
+fat = dict(base_row)
+fat["peak_device_trace_bytes"] = base_row["peak_device_trace_bytes"] * 2
+warn = results_db.check_regression(rdb, "streamed", fat)
+assert warn and "peak_device_trace_bytes" in warn, warn
+shutil.rmtree(tmp)
+print("STREAMED INGEST SMOKE OK (%d seams, %d segments, counters + "
+      "completion identical to whole-trace, stall/footprint "
+      "regression flags fire)" % (ing["seams"], ing["num_segments"]))
+PYEOF
+)
+ingest_rc=$?
+echo "$ingest_out" | tail -3
+if [ $ingest_rc -ne 0 ]; then
+    echo "STREAMED INGEST GATE FAILED"
+    fail=1
+fi
+
 if [ $fail -eq 0 ]; then
     echo "ALL MODULES PASSED"
 else
